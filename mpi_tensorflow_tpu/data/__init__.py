@@ -1,0 +1,22 @@
+"""Data layer: in-repo IDX parsing, dataset pipelines, per-host sharding.
+
+Replaces the reference's external ``convolutional`` import (mpipy.py:12),
+``data_exist_here`` downloader (mpipy.py:185-199), and root-0 ``MPI.Scatter``
+distribution (mpipy.py:230-241).
+"""
+
+from mpi_tensorflow_tpu.data.idx import (  # noqa: F401
+    extract_images,
+    extract_labels,
+    error_rate,
+    read_idx,
+    write_idx,
+)
+from mpi_tensorflow_tpu.data.sharding import (  # noqa: F401
+    batch_iterator,
+    host_shard,
+    make_global_array,
+    shard_array,
+    steps_per_run,
+    truncate_to_multiple,
+)

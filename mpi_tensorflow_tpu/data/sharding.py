@@ -1,0 +1,88 @@
+"""Per-host / per-device contiguous data sharding.
+
+Replaces the reference's root-0 ``comm.Scatter`` fan-out (mpipy.py:236-241)
+with the TPU-idiomatic pattern: every host computes its own contiguous slice
+(no root bottleneck, no second copy), and
+``jax.make_array_from_process_local_data`` assembles the global sharded array
+when a mesh is involved.
+
+Semantics preserved from the reference:
+- sizes truncated to a multiple of the shard count (``55000//size*size`` etc.,
+  mpipy.py:211-213);
+- shard ``i`` receives rows ``[i*n/k, (i+1)*n/k)`` — ``MPI.Scatter`` on a
+  contiguous buffer is exactly contiguous equal chunks.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import numpy as np
+
+
+def truncate_to_multiple(n: int, k: int) -> int:
+    """``n//k*k`` — the reference's size truncation (mpipy.py:211-213)."""
+    return n // k * k
+
+
+def shard_bounds(n: int, num_shards: int, index: int) -> tuple[int, int]:
+    """[start, stop) row range of contiguous equal shard ``index`` of ``n``
+    rows (rows past ``n//num_shards*num_shards`` are dropped, as Scatter
+    drops them in the reference)."""
+    if not 0 <= index < num_shards:
+        raise ValueError(f"shard index {index} out of range [0, {num_shards})")
+    per = n // num_shards
+    return index * per, (index + 1) * per
+
+
+def shard_array(x: np.ndarray, num_shards: int, index: int) -> np.ndarray:
+    """The rows of ``x`` that shard ``index`` owns."""
+    start, stop = shard_bounds(x.shape[0], num_shards, index)
+    return x[start:stop]
+
+
+def shard_arrays(arrays: Iterable[np.ndarray], num_shards: int, index: int):
+    return tuple(shard_array(a, num_shards, index) for a in arrays)
+
+
+def host_shard(x: np.ndarray, process_index: int | None = None,
+               process_count: int | None = None) -> np.ndarray:
+    """This host's contiguous slice, by ``jax.process_index()``.
+
+    On a multi-host pod each host loads/keeps only the rows that feed its
+    addressable devices — the Scatter equivalent with no root-0 bottleneck
+    (SURVEY.md §7 "Hard parts").
+    """
+    import jax  # deferred: keep numpy-only callers jax-free
+
+    if process_index is None:
+        process_index = jax.process_index()
+    if process_count is None:
+        process_count = jax.process_count()
+    return shard_array(x, process_count, process_index)
+
+
+def make_global_array(local_batch: np.ndarray, mesh, pspec):
+    """Assemble per-host local rows into one global jax.Array sharded over
+    ``mesh`` by ``pspec`` (batch-axis sharding over the 'data' mesh axis)."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    sharding = NamedSharding(mesh, pspec)
+    return jax.make_array_from_process_local_data(sharding, local_batch)
+
+
+def batch_iterator(data: np.ndarray, labels: np.ndarray, batch_size: int,
+                   num_steps: int, start_step: int = 0):
+    """Sequential wraparound batch slicing, no shuffling — the reference's
+    batching exactly (mpipy.py:80-82): ``offset = (step*B) % (N - B)``.
+    """
+    n = labels.shape[0]
+    for step in range(start_step, num_steps):
+        offset = (step * batch_size) % (n - batch_size)
+        yield step, data[offset:offset + batch_size], labels[offset:offset + batch_size]
+
+
+def steps_per_run(num_examples: int, batch_size: int, epochs: int) -> int:
+    """``iteration * local_train_size // batch_size`` (mpipy.py:79)."""
+    return epochs * num_examples // batch_size

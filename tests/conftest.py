@@ -1,0 +1,37 @@
+"""Test harness: run every test on an 8-device virtual CPU mesh.
+
+The reference's only way to exercise its distributed path is a real
+``mpiexec -n N`` launch (SURVEY.md §4).  Here the same multi-device code runs
+in-process: the env vars below must be set before ``jax`` is imported anywhere,
+which conftest import-time guarantees under pytest.
+"""
+
+import os
+
+# Neutralize the axon TPU plugin hook (it keys off this var) and force a
+# virtual 8-device CPU platform so mesh/psum code runs 8-way with no TPU.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_NUM_CPU_DEVICES"] = "8"
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    import jax
+
+    assert len(jax.devices()) == 8, "virtual 8-device CPU platform not active"
+    return jax.make_mesh((8,), ("data",))
+
+
+@pytest.fixture(scope="session")
+def mnist_dir(tmp_path_factory):
+    """A small synthetic MNIST in IDX format (1200 train / 256 test)."""
+    from mpi_tensorflow_tpu.data import mnist
+
+    d = tmp_path_factory.mktemp("mnist")
+    mnist._write_synthetic(str(d), train_n=1200, test_n=256)
+    return str(d)

@@ -1,0 +1,123 @@
+"""Data layer tests: IDX round-trip, extraction semantics, sharding math."""
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.data import idx, mnist, sharding
+
+
+class TestIdx:
+    @pytest.mark.parametrize("gz", [False, True])
+    @pytest.mark.parametrize(
+        "arr",
+        [
+            np.arange(24, dtype=np.uint8).reshape(2, 3, 4),
+            np.arange(10, dtype=np.uint8),
+            np.linspace(-1, 1, 12, dtype=np.float32).reshape(3, 4),
+            np.arange(-5, 5, dtype=np.int32),
+        ],
+    )
+    def test_roundtrip(self, tmp_path, gz, arr):
+        p = str(tmp_path / ("a.idx.gz" if gz else "a.idx"))
+        idx.write_idx(p, arr)
+        out = idx.read_idx(p)
+        assert out.dtype == arr.dtype.newbyteorder(">") or out.dtype == arr.dtype
+        np.testing.assert_array_equal(np.asarray(out, dtype=arr.dtype), arr)
+
+    def test_max_items(self, tmp_path):
+        p = str(tmp_path / "b.idx")
+        idx.write_idx(p, np.arange(100, dtype=np.uint8).reshape(10, 10))
+        out = idx.read_idx(p, max_items=3)
+        assert out.shape == (3, 10)
+
+    def test_bad_magic(self, tmp_path):
+        p = tmp_path / "bad.idx"
+        p.write_bytes(b"\x01\x02\x03\x04rest")
+        with pytest.raises(ValueError, match="magic"):
+            idx.read_idx(str(p))
+
+    def test_extract_images_normalization(self, tmp_path):
+        """Pixels map via (p - 127.5)/255 -> [-0.5, 0.5], shape (N,28,28,1)."""
+        p = str(tmp_path / "img.idx.gz")
+        raw = np.zeros((4, 28, 28), dtype=np.uint8)
+        raw[0] = 0
+        raw[1] = 255
+        raw[2] = 127
+        idx.write_idx(p, raw)
+        out = idx.extract_images(p)
+        assert out.shape == (4, 28, 28, 1) and out.dtype == np.float32
+        assert np.allclose(out[0], -0.5)
+        assert np.allclose(out[1], 0.5)
+        assert np.allclose(out[2], (127 - 127.5) / 255)
+
+    def test_extract_labels_dtype(self, tmp_path):
+        p = str(tmp_path / "lbl.idx.gz")
+        idx.write_idx(p, np.arange(10, dtype=np.uint8))
+        out = idx.extract_labels(p)
+        assert out.dtype == np.int64 and out.shape == (10,)
+
+    def test_error_rate(self):
+        preds = np.eye(10, dtype=np.float32)  # argmax = 0..9
+        labels = np.arange(10)
+        assert idx.error_rate(preds, labels) == 0.0
+        labels2 = labels.copy()
+        labels2[0] = 5
+        assert idx.error_rate(preds, labels2) == pytest.approx(10.0)
+
+
+class TestSharding:
+    def test_truncate(self):
+        # the reference's 55000//size*size etc. (mpipy.py:211-213)
+        assert sharding.truncate_to_multiple(55000, 8) == 55000
+        assert sharding.truncate_to_multiple(10000, 3) == 9999
+        assert sharding.truncate_to_multiple(10000, 7) == 1428 * 7
+
+    def test_contiguous_equal_shards(self):
+        x = np.arange(100)
+        shards = [sharding.shard_array(x, 4, i) for i in range(4)]
+        assert all(s.shape == (25,) for s in shards)
+        np.testing.assert_array_equal(np.concatenate(shards), x)
+
+    def test_batch_iterator_wraparound(self):
+        """offset = (step*B) % (N-B), sequential, no shuffle (mpipy.py:80-82)."""
+        data = np.arange(100)[:, None]
+        labels = np.arange(100)
+        batches = list(sharding.batch_iterator(data, labels, 30, 5))
+        offsets = [b[1][0, 0] for b in batches]
+        assert offsets == [0, 30, 60, (90 % 70), (120 % 70)]
+        assert all(b[1].shape == (30, 1) for b in batches)
+
+    def test_steps_per_run(self):
+        # iteration * local_train_size // batch_size (mpipy.py:79)
+        assert sharding.steps_per_run(50000, 64, 2) == 2 * 50000 // 64
+
+
+class TestMnist:
+    def test_synthetic_load_and_split(self, mnist_dir):
+        sp = mnist.load_splits(mnist_dir, num_shards=4, train_n=1200, test_n=256)
+        # val = first 1/12 of train pool, truncated to multiple of 4
+        assert sp.val_data.shape[0] == (1200 * 5000 // 60000) // 4 * 4
+        assert sp.train_data.shape[0] + sp.val_data.shape[0] \
+            == (1200 * 55000 // 60000) // 4 * 4
+        assert sp.test_data.shape == (256, 28, 28, 1)
+        assert sp.train_data.dtype == np.float32
+        assert sp.train_labels.dtype == np.int64
+        assert sp.train_labels.min() >= 0 and sp.train_labels.max() <= 9
+
+    def test_shard_consistency(self, mnist_dir):
+        sp = mnist.load_splits(mnist_dir, num_shards=4, train_n=1200, test_n=256)
+        shards = [sp.shard(4, i) for i in range(4)]
+        rebuilt = np.concatenate([s.train_data for s in shards])
+        np.testing.assert_array_equal(rebuilt, sp.train_data)
+        # test data is sharded too (each rank evaluates a different subset,
+        # SURVEY.md §2 #5)
+        assert shards[0].test_data.shape[0] == 256 // 4
+
+    def test_synthetic_is_deterministic(self, tmp_path):
+        d1, d2 = tmp_path / "a", tmp_path / "b"
+        d1.mkdir(); d2.mkdir()
+        mnist._write_synthetic(str(d1), train_n=64, test_n=32)
+        mnist._write_synthetic(str(d2), train_n=64, test_n=32)
+        a = idx.extract_images(str(d1 / mnist.FILES["train_images"]))
+        b = idx.extract_images(str(d2 / mnist.FILES["train_images"]))
+        np.testing.assert_array_equal(a, b)
