@@ -1,0 +1,45 @@
+"""Model protocol shared by every model family.
+
+The reference hard-wires its single model into the trainer (graph built inside
+``Cnn.__init__``, mpipy.py:24-71).  Here a model is a small stateless object
+and the train step is model-agnostic — swapping MNIST-CNN for ResNet-50 or
+BERT changes only which ``Model`` is constructed (SURVEY.md §7 build order #7:
+"the proof the design is a framework, not a script").
+
+Contract:
+- ``init(rng) -> params``: a pytree of ``jnp`` arrays.
+- ``apply(params, inputs, *, train, rng=None) -> logits``: pure forward.
+  ``train`` gates dropout; ``rng`` is required iff the model uses dropout and
+  ``train`` is True.  (This deliberately fixes the reference's eval-dropout
+  bug — mpipy.py:68 reuses the dropout-bearing ``model()`` for eval.)
+- ``l2_params(params) -> list``: the sub-set of parameters subject to L2
+  regularization (the reference penalizes fc weights AND biases only,
+  mpipy.py:57-58).
+- ``logical_axes(params) -> pytree of PartitionSpec-like tuples`` (optional):
+  logical sharding axes per parameter, consumed by ``parallel.sharding_rules``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Protocol, runtime_checkable
+
+Params = Any
+
+
+@runtime_checkable
+class Model(Protocol):
+    num_classes: int
+
+    def init(self, rng) -> Params: ...
+
+    def apply(self, params: Params, inputs, *, train: bool = False,
+              rng=None) -> Any: ...
+
+    def l2_params(self, params: Params) -> list: ...
+
+
+def l2_loss(x) -> Any:
+    """``tf.nn.l2_loss`` semantics: ``sum(x**2) / 2`` (used at mpipy.py:57-58)."""
+    import jax.numpy as jnp
+
+    return jnp.sum(jnp.square(x)) / 2.0

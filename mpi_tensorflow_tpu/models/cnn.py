@@ -1,0 +1,105 @@
+"""The reference MNIST CNN, re-built as a pure-JAX function.
+
+Architecture (mpipy.py:38-53, 155-167):
+  [conv 5x5 SAME -> bias -> relu -> maxpool 2x2 SAME] x2 (32 then 64 channels)
+  -> flatten (NHWC row-major, matching TF's reshape at mpipy.py:163)
+  -> fc 512 + relu -> dropout 0.5 (train only; the reference applies dropout
+     in eval too — deliberate fix, see models/base.py) -> fc num_classes.
+
+Init (mpipy.py:38-53): weights truncated-normal stddev 0.1 (TF
+``truncated_normal``: resample outside 2 sigma); biases: conv1 zeros, the rest
+constant 0.1.  The reference reuses seed 1 for every weight — giving conv1 and
+conv2 *correlated* values; we derive per-parameter keys from one seed instead
+(documented divergence, statistically equivalent init scale).
+
+TPU notes: convolutions run NHWC through ``lax.conv_general_dilated`` (XLA
+lowers to MXU); arithmetic is float32 by default with optional bfloat16
+compute (``compute_dtype``) for MXU throughput, keeping params in float32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def truncated_normal(key, shape, stddev=0.1, dtype=jnp.float32):
+    """TF ``tf.truncated_normal`` semantics: N(0,1) truncated to [-2, 2],
+    scaled by ``stddev`` (init at mpipy.py:38-53)."""
+    return jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype) * stddev
+
+
+def max_pool_2x2_same(x):
+    """``tf.nn.max_pool`` ksize 2x2 stride 2 padding SAME (mpipy.py:158, 161)."""
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, 2, 2, 1),
+        window_strides=(1, 2, 2, 1),
+        padding="SAME",
+    )
+
+
+def conv2d_same(x, w):
+    """``tf.nn.conv2d`` stride 1 padding SAME, NHWC/HWIO (mpipy.py:156, 159)."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class MnistCnn:
+    image_size: int = 28
+    num_channels: int = 1
+    num_classes: int = 10
+    hidden: int = 512
+    dropout_rate: float = 0.5
+    compute_dtype: Any = jnp.float32
+
+    @property
+    def flat_dim(self) -> int:
+        # image_size//4 * image_size//4 * 64 (mpipy.py:46)
+        return (self.image_size // 4) ** 2 * 64
+
+    def init(self, rng):
+        k1, k2, k3, k4 = jax.random.split(rng, 4)
+        s, c = self.image_size, self.num_channels
+        return {
+            "conv1_w": truncated_normal(k1, (5, 5, c, 32)),
+            "conv1_b": jnp.zeros((32,)),                       # mpipy.py:41
+            "conv2_w": truncated_normal(k2, (5, 5, 32, 64)),
+            "conv2_b": jnp.full((64,), 0.1),                   # mpipy.py:45
+            "fc1_w": truncated_normal(k3, (self.flat_dim, self.hidden)),
+            "fc1_b": jnp.full((self.hidden,), 0.1),            # mpipy.py:49
+            "fc2_w": truncated_normal(k4, (self.hidden, self.num_classes)),
+            "fc2_b": jnp.full((self.num_classes,), 0.1),       # mpipy.py:53
+        }
+
+    def apply(self, params, inputs, *, train: bool = False, rng=None):
+        dt = self.compute_dtype
+        x = inputs.astype(dt)
+        x = jax.nn.relu(conv2d_same(x, params["conv1_w"].astype(dt))
+                        + params["conv1_b"].astype(dt))
+        x = max_pool_2x2_same(x)
+        x = jax.nn.relu(conv2d_same(x, params["conv2_w"].astype(dt))
+                        + params["conv2_b"].astype(dt))
+        x = max_pool_2x2_same(x)
+        x = x.reshape(x.shape[0], -1)  # NHWC row-major flatten (mpipy.py:163)
+        x = jax.nn.relu(x @ params["fc1_w"].astype(dt) + params["fc1_b"].astype(dt))
+        if train:
+            if rng is None:
+                raise ValueError("dropout needs an rng in train mode")
+            keep = 1.0 - self.dropout_rate
+            mask = jax.random.bernoulli(rng, keep, x.shape)
+            x = jnp.where(mask, x / keep, 0.0)  # tf.nn.dropout scaling (mpipy.py:166)
+        logits = x @ params["fc2_w"].astype(dt) + params["fc2_b"].astype(dt)
+        return logits.astype(jnp.float32)
+
+    def l2_params(self, params) -> list:
+        # fc weights AND biases only (mpipy.py:57-58)
+        return [params["fc1_w"], params["fc1_b"],
+                params["fc2_w"], params["fc2_b"]]

@@ -1,0 +1,94 @@
+"""Collectives: the MPI roles the reference uses, on XLA primitives.
+
+The reference's communication surface (SURVEY.md §2 #8) is: ``Scatter`` x6 at
+startup (mpipy.py:236-241), ``Gather`` x4 per sync (mpipy.py:121-127), and —
+notably absent — the ``Allreduce`` its own README promises.  On TPU these
+roles map to:
+
+| MPI role (reference)        | TPU-native primitive here                  |
+|-----------------------------|--------------------------------------------|
+| ``Scatter`` (root-0 fan-out)| per-host slicing (``data.sharding``) — no  |
+|                             | root, no network fan-out needed            |
+| ``Gather`` (to root)        | ``all_gather`` in-graph / host             |
+|                             | ``process_allgather`` for metrics          |
+| ``Allreduce`` (intended)    | ``psum`` / ``pmean`` over the mesh axis    |
+| ``Bcast`` (absent but      | ``pbroadcast`` below (mask + psum)          |
+| needed for correct avg)     |                                            |
+| ``Barrier`` (commented out, | unnecessary in-graph (SPMD program order); |
+| mpipy.py:93)                | ``sync_global_devices`` for host phases    |
+
+All in-graph functions below must be called inside ``shard_map`` (they take a
+mesh axis *name*).  They are thin, typed wrappers — the point is to make the
+communication layer an explicit, testable component like the reference's,
+rather than scattering raw ``lax`` calls through the codebase.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def allreduce_sum(x, axis: str = "data"):
+    """The per-step gradient reduction (the reference's *intended* op)."""
+    return lax.psum(x, axis)
+
+
+def allreduce_mean(x, axis: str = "data"):
+    """Normalized allreduce — equals the reference's ``np.mean(gathered, 0)``
+    at mpipy.py:130-137, but delivered to every shard, not just rank 0."""
+    return lax.pmean(x, axis)
+
+
+def allreduce_max(x, axis: str = "data"):
+    return lax.pmax(x, axis)
+
+
+def allgather(x, axis: str = "data", *, tiled: bool = False):
+    """``MPI.Gather``-to-all (mpipy.py:121-127 gathers to root; on TPU the
+    symmetric form is natural and costs the same over ICI)."""
+    return lax.all_gather(x, axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis: str = "data"):
+    """Sum-and-shard along the leading dim — the building block for sharded
+    optimizer states (ZeRO-style; absent from the reference)."""
+    return lax.psum_scatter(x, axis, tiled=True)
+
+
+def pbroadcast(x, axis: str = "data", root: int = 0):
+    """``MPI.Bcast`` from ``root`` — the collective the reference's
+    ``bcast_parameters`` is named for but never performs (SURVEY.md §2 #11)."""
+    idx = lax.axis_index(axis)
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis)
+
+
+def ppermute_shift(x, axis: str, shift: int = 1):
+    """Ring rotation by ``shift`` — the primitive under ring attention."""
+    n = lax.axis_size(axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str = "data"):
+    """In-graph shard id — the ``comm.Get_rank()`` analogue inside a step."""
+    return lax.axis_index(axis)
+
+
+# --- host-level (outside jit) ---
+
+def host_allgather(x):
+    """Gather a host-local array across processes (metric aggregation —
+    replaces the reference's root-0 Gather of weights for averaging)."""
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def barrier(name: str = "barrier"):
+    """Cross-host sync point (the reference's commented-out ``Barrier``,
+    mpipy.py:93)."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)

@@ -1,0 +1,79 @@
+"""Optimizer and LR schedule matching the reference's TF-v1 semantics.
+
+Reference (mpipy.py:59-66):
+- global step: a float32 variable ``iter_`` incremented per apply;
+- LR: ``tf.train.exponential_decay(0.01, iter_*batch_size,
+  decay_steps=local_train_size, 0.95, staircase=True)`` — i.e.
+  ``0.01 * 0.95 ** floor(step * batch_size / local_train_size)`` (one decay
+  per local epoch);
+- ``tf.train.MomentumOptimizer(lr, 0.9)``: ``accum = m*accum + grad;
+  var -= lr * accum`` (lr applied at update time, not folded into the
+  accumulator).
+
+Everything here is pure and jit-safe (runs in-graph on TPU — the schedule is
+computed on device, no host round-trip per step).  An ``optax`` adapter is
+provided so the rest of the ecosystem's optimizers slot into the same train
+step.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+def exponential_decay(base_lr, step, batch_size, decay_steps, rate,
+                      staircase=True):
+    """``tf.train.exponential_decay`` with the reference's arguments
+    (mpipy.py:60-64).  ``step`` may be a traced scalar."""
+    progress = step * batch_size / decay_steps
+    if staircase:
+        progress = jnp.floor(progress)
+    return base_lr * jnp.power(rate, progress)
+
+
+class MomentumState(NamedTuple):
+    velocity: dict      # same pytree structure as params
+    step: jnp.ndarray   # float32 scalar, like the reference's ``iter_``
+                        # (mpipy.py:59 declares it float32)
+
+
+def momentum_init(params) -> MomentumState:
+    return MomentumState(
+        velocity=jax.tree.map(jnp.zeros_like, params),
+        step=jnp.zeros((), jnp.float32),
+    )
+
+
+def momentum_apply(params, grads, state: MomentumState, lr, momentum=0.9):
+    """One TF ``MomentumOptimizer`` update: v = m*v + g; p -= lr*v."""
+    new_v = jax.tree.map(lambda v, g: momentum * v + g, state.velocity, grads)
+    new_p = jax.tree.map(lambda p, v: p - lr * v, params, new_v)
+    return new_p, MomentumState(new_v, state.step + 1.0)
+
+
+def reference_schedule(config, local_train_size: int):
+    """The reference's LR schedule closed over a run's local train size."""
+    def schedule(step):
+        return exponential_decay(config.base_lr, step, config.batch_size,
+                                 local_train_size, config.lr_decay,
+                                 staircase=True)
+    return schedule
+
+
+def make_optax(config, local_train_size: int) -> optax.GradientTransformation:
+    """The reference optimizer expressed as an optax chain, for models that
+    want the optax ecosystem (ResNet/BERT runs may swap in adamw etc.)."""
+    schedule = reference_schedule(config, local_train_size)
+    return optax.chain(
+        optax.trace(decay=config.momentum, nesterov=False),
+        optax.scale_by_learning_rate(schedule),  # also negates
+    )
+
+
+def adamw(learning_rate=1e-4, weight_decay=0.01, **kw):
+    """Convenience passthrough for transformer runs (BASELINE config 5)."""
+    return optax.adamw(learning_rate, weight_decay=weight_decay, **kw)

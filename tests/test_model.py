@@ -1,0 +1,141 @@
+"""Model + optimizer golden-value tests (SURVEY.md §4 test strategy)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.models import cnn
+from mpi_tensorflow_tpu.models.base import l2_loss
+from mpi_tensorflow_tpu.train import optimizer, step
+
+
+@pytest.fixture(scope="module")
+def model():
+    return cnn.MnistCnn()
+
+
+@pytest.fixture(scope="module")
+def params(model):
+    return model.init(jax.random.key(1))
+
+
+class TestCnn:
+    def test_param_shapes(self, params):
+        # exact variable shapes from mpipy.py:38-53
+        shapes = {k: v.shape for k, v in params.items()}
+        assert shapes == {
+            "conv1_w": (5, 5, 1, 32), "conv1_b": (32,),
+            "conv2_w": (5, 5, 32, 64), "conv2_b": (64,),
+            "fc1_w": (7 * 7 * 64, 512), "fc1_b": (512,),
+            "fc2_w": (512, 10), "fc2_b": (10,),
+        }
+
+    def test_init_values(self, params):
+        # truncated normal stddev 0.1: bounded by 0.2, sane spread
+        w = np.asarray(params["fc1_w"])
+        assert np.abs(w).max() <= 0.2 + 1e-6
+        assert 0.05 < w.std() < 0.12
+        assert np.allclose(params["conv1_b"], 0.0)     # mpipy.py:41
+        assert np.allclose(params["conv2_b"], 0.1)     # mpipy.py:45
+        assert np.allclose(params["fc2_b"], 0.1)       # mpipy.py:53
+
+    def test_forward_shape_and_determinism(self, model, params):
+        x = jnp.zeros((4, 28, 28, 1))
+        out = model.apply(params, x, train=False)
+        assert out.shape == (4, 10)
+        out2 = model.apply(params, x, train=False)
+        np.testing.assert_array_equal(out, out2)
+
+    def test_conv_matches_manual(self):
+        """lax SAME conv vs a hand-rolled numpy conv on a tiny case."""
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(1, 6, 6, 1)).astype(np.float32)
+        w = rng.normal(size=(5, 5, 1, 2)).astype(np.float32)
+        with jax.default_matmul_precision("highest"):
+            got = np.asarray(cnn.conv2d_same(jnp.array(x), jnp.array(w)))
+        pad = np.pad(x[0, :, :, 0], 2)
+        want = np.zeros((6, 6, 2), np.float32)
+        for i in range(6):
+            for j in range(6):
+                patch = pad[i:i + 5, j:j + 5]
+                for c in range(2):
+                    want[i, j, c] = np.sum(patch * w[:, :, 0, c])
+        np.testing.assert_allclose(got[0], want, rtol=2e-4, atol=2e-4)
+
+    def test_maxpool_same(self):
+        x = jnp.arange(16.0).reshape(1, 4, 4, 1)
+        out = cnn.max_pool_2x2_same(x)
+        np.testing.assert_array_equal(
+            np.asarray(out)[0, :, :, 0], [[5, 7], [13, 15]])
+        # SAME on odd size keeps ceil(n/2)
+        assert cnn.max_pool_2x2_same(jnp.zeros((1, 5, 5, 1))).shape == (1, 3, 3, 1)
+
+    def test_dropout_train_only(self, model, params):
+        """The eval-dropout bug (mpipy.py:68) is deliberately fixed: eval is
+        deterministic; train with dropout differs from eval."""
+        x = jnp.ones((2, 28, 28, 1)) * 0.3
+        ev = model.apply(params, x, train=False)
+        tr = model.apply(params, x, train=True, rng=jax.random.key(0))
+        assert not np.allclose(ev, tr)
+        with pytest.raises(ValueError):
+            model.apply(params, x, train=True)
+
+    def test_l2_subset_is_fc_only(self, model, params):
+        subset = model.l2_params(params)
+        assert len(subset) == 4  # fc1_w, fc1_b, fc2_w, fc2_b (mpipy.py:57-58)
+        sizes = sorted(int(np.prod(p.shape)) for p in subset)
+        assert sizes == [10, 512, 512 * 10, 7 * 7 * 64 * 512]
+
+    def test_l2_loss_semantics(self):
+        # tf.nn.l2_loss = sum(x^2)/2
+        assert float(l2_loss(jnp.array([3.0, 4.0]))) == pytest.approx(12.5)
+
+
+class TestOptimizer:
+    def test_exponential_decay_staircase(self):
+        """Golden values of tf.train.exponential_decay(0.01, step*64,
+        50000, 0.95, staircase=True) (mpipy.py:60-64)."""
+        f = lambda s: float(optimizer.exponential_decay(0.01, jnp.float32(s),
+                                                        64, 50000, 0.95))
+        assert f(0) == pytest.approx(0.01)
+        assert f(781) == pytest.approx(0.01)          # 781*64=49984 < 50000
+        assert f(782) == pytest.approx(0.0095)        # first decay
+        assert f(2 * 782) == pytest.approx(0.01 * 0.95 ** 2)
+
+    def test_momentum_matches_tf_semantics(self):
+        """v = m*v + g; p -= lr*v — two manual steps."""
+        params = {"w": jnp.array([1.0])}
+        state = optimizer.momentum_init(params)
+        g = {"w": jnp.array([0.5])}
+        p1, s1 = optimizer.momentum_apply(params, g, state, lr=0.1, momentum=0.9)
+        assert float(p1["w"][0]) == pytest.approx(1.0 - 0.1 * 0.5)
+        p2, s2 = optimizer.momentum_apply(p1, g, s1, lr=0.1, momentum=0.9)
+        # v2 = 0.9*0.5 + 0.5 = 0.95
+        assert float(p2["w"][0]) == pytest.approx(float(p1["w"][0]) - 0.1 * 0.95)
+        assert float(s2.step) == 2.0
+
+    def test_optax_chain_matches_manual(self):
+        cfg = Config()
+        params = {"w": jnp.array([1.0, -2.0])}
+        g = {"w": jnp.array([0.3, 0.1])}
+        tx = optimizer.make_optax(cfg, local_train_size=50000)
+        opt_state = tx.init(params)
+        man_state = optimizer.momentum_init(params)
+        p_opt, p_man = params, params
+        for i in range(3):
+            updates, opt_state = tx.update(g, opt_state, p_opt)
+            p_opt = jax.tree.map(lambda p, u: p + u, p_opt, updates)
+            lr = optimizer.exponential_decay(cfg.base_lr, man_state.step,
+                                             cfg.batch_size, 50000, cfg.lr_decay)
+            p_man, man_state = optimizer.momentum_apply(p_man, g, man_state,
+                                                        lr, cfg.momentum)
+        np.testing.assert_allclose(p_opt["w"], p_man["w"], rtol=1e-6)
+
+    def test_softmax_ce_golden(self):
+        logits = jnp.array([[2.0, 1.0, 0.0]])
+        labels = jnp.array([0])
+        got = float(step.optax_softmax_ce(logits, labels)[0])
+        want = -np.log(np.exp(2) / (np.exp(2) + np.exp(1) + np.exp(0)))
+        assert got == pytest.approx(want, rel=1e-4)

@@ -1,0 +1,81 @@
+"""Parallel layer tests on the 8-device virtual CPU mesh (SURVEY.md §4:
+'multi-host-without-a-cluster' testing)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from mpi_tensorflow_tpu.parallel import collectives, mesh as meshlib
+
+
+class TestMesh:
+    def test_default_mesh_all_devices(self, mesh8):
+        assert meshlib.data_axis_size(mesh8) == 8
+
+    def test_make_mesh_shapes(self):
+        m = meshlib.make_mesh({"data": 4, "model": 2})
+        assert m.shape == {"data": 4, "model": 2}
+        m2 = meshlib.make_mesh({"data": -1, "model": 2})
+        assert m2.shape == {"data": 4, "model": 2}
+        with pytest.raises(ValueError):
+            meshlib.make_mesh({"data": 3})
+
+    def test_process_info_single_host(self):
+        assert meshlib.process_index() == 0
+        assert meshlib.process_count() == 1
+
+
+class TestCollectives:
+    def _run(self, mesh, fn, x, in_spec=P("data"), out_spec=P("data")):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                             out_specs=out_spec)(x)
+
+    def test_allreduce_sum_and_mean(self, mesh8):
+        x = np.arange(8.0)
+        out = self._run(mesh8, lambda v: collectives.allreduce_sum(v), x,
+                        out_spec=P())
+        assert float(out[0]) == 28.0
+        out = self._run(mesh8, lambda v: collectives.allreduce_mean(v), x,
+                        out_spec=P())
+        assert float(out[0]) == pytest.approx(3.5)
+
+    def test_allgather(self, mesh8):
+        x = np.arange(8.0)
+        out = self._run(mesh8, lambda v: collectives.allgather(v, tiled=True),
+                        x, out_spec=P())
+        np.testing.assert_array_equal(out, x)
+
+    def test_pbroadcast_from_root(self, mesh8):
+        """The Bcast the reference's bcast_parameters never does."""
+        x = np.arange(8.0) + 1.0
+
+        def f(v):
+            return collectives.pbroadcast(v, root=3)
+
+        out = self._run(mesh8, f, x)
+        np.testing.assert_array_equal(out, np.full(8, 4.0))
+
+    def test_reduce_scatter(self, mesh8):
+        x = np.tile(np.arange(8.0), (8, 1))  # every shard holds rows 0..7
+
+        def f(v):  # v: (1, 8) per shard
+            return collectives.reduce_scatter(v[0])
+
+        out = self._run(mesh8, f, x.reshape(8, 1, 8),
+                        in_spec=P("data"), out_spec=P("data"))
+        np.testing.assert_array_equal(np.asarray(out).ravel(),
+                                      np.arange(8.0) * 8)
+
+    def test_ppermute_shift(self, mesh8):
+        x = np.arange(8.0)
+        out = self._run(mesh8, lambda v: collectives.ppermute_shift(v, "data", 1), x)
+        # shard i's value moves to shard i+1
+        np.testing.assert_array_equal(out, np.roll(x, 1))
+
+    def test_axis_index(self, mesh8):
+        out = self._run(mesh8,
+                        lambda v: v * 0 + collectives.axis_index("data"),
+                        np.zeros(8))
+        np.testing.assert_array_equal(out, np.arange(8))

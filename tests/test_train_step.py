@@ -1,0 +1,120 @@
+"""Train-step tests: sync-SGD equivalence, avg50 fidelity mode, eval."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.models import cnn
+from mpi_tensorflow_tpu.train import evaluation, step
+
+
+@pytest.fixture(scope="module")
+def setup(mesh8):
+    cfg = Config(batch_size=16, dropout_rate=0.0)  # dropout off -> exact math
+    model = cnn.MnistCnn(dropout_rate=0.0)
+    state = step.init_state(model, jax.random.key(1))
+    rng = np.random.default_rng(0)
+    batch = rng.normal(size=(16, 28, 28, 1)).astype(np.float32) * 0.3
+    labels = rng.integers(0, 10, size=(16,)).astype(np.int64)
+    return cfg, model, state, batch, labels
+
+
+class TestSyncStep:
+    def test_runs_and_updates(self, mesh8, setup):
+        cfg, model, state, batch, labels = setup
+        train_step = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
+        new_state, metrics = train_step(state, batch, labels, jax.random.key(0))
+        assert float(metrics["loss"]) > 0
+        assert float(metrics["lr"]) == pytest.approx(cfg.base_lr)
+        assert float(new_state.opt.step) == 1.0
+        # params moved
+        assert not np.allclose(new_state.params["fc2_w"], state.params["fc2_w"])
+
+    def test_matches_single_device_sgd(self, mesh8, setup):
+        """8-way data-parallel pmean-of-grads == single-device full-batch SGD.
+        This is the correctness contract of the psum path."""
+        cfg, model, state, batch, labels = setup
+        train_step = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
+        multi, _ = train_step(state, batch, labels, jax.random.key(0))
+
+        # single device reference: plain value_and_grad on the full batch
+        loss_fn = step.make_loss_fn(model, cfg)
+        from mpi_tensorflow_tpu.train import optimizer as opt
+        grads = jax.grad(loss_fn)(state.params, jnp.array(batch),
+                                  jnp.array(labels), jax.random.key(9))
+        lr = opt.exponential_decay(cfg.base_lr, state.opt.step,
+                                   cfg.batch_size, 1000, cfg.lr_decay)
+        want_params, _ = opt.momentum_apply(state.params, grads, state.opt,
+                                            lr, cfg.momentum)
+        for k in want_params:
+            np.testing.assert_allclose(multi.params[k], want_params[k],
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_deterministic(self, mesh8, setup):
+        cfg, model, state, batch, labels = setup
+        train_step = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
+        a, _ = train_step(state, batch, labels, jax.random.key(0))
+        b, _ = train_step(state, batch, labels, jax.random.key(0))
+        for k in a.params:
+            np.testing.assert_array_equal(a.params[k], b.params[k])
+
+
+class TestAvg50:
+    def test_local_steps_diverge_then_average(self, mesh8, setup):
+        cfg, model, state, batch, labels = setup
+        local_step = step.make_local_train_step(model, cfg, mesh8,
+                                                decay_steps=1000)
+        avg_step = step.make_average_step(mesh8)
+        stacked = step.stack_state(state, 8)
+        new, metrics = local_step(stacked, batch, labels, jax.random.key(0))
+        assert metrics["loss"].shape == (8,)
+        # shards saw different data -> diverged params
+        p = np.asarray(new.params["fc2_w"])
+        assert not np.allclose(p[0], p[1])
+        # averaging brings every shard to the same value (the fixed Bcast)
+        averaged = avg_step(new)
+        p = np.asarray(averaged.params["fc2_w"])
+        for i in range(1, 8):
+            np.testing.assert_allclose(p[0], p[i], rtol=1e-6)
+
+    def test_average_is_mean(self, mesh8, setup):
+        cfg, model, state, batch, labels = setup
+        local_step = step.make_local_train_step(model, cfg, mesh8, 1000)
+        avg_step = step.make_average_step(mesh8)
+        stacked = step.stack_state(state, 8)
+        new, _ = local_step(stacked, batch, labels, jax.random.key(0))
+        want = np.mean(np.asarray(new.params["fc1_b"]), axis=0)
+        averaged = avg_step(new)
+        np.testing.assert_allclose(np.asarray(averaged.params["fc1_b"])[0],
+                                   want, rtol=1e-6)
+
+
+class TestEval:
+    def test_eval_in_batches_tail(self, mesh8, setup):
+        cfg, model, state, batch, labels = setup
+        eval_step = step.make_eval_step(model, cfg, mesh8)
+        rng = np.random.default_rng(1)
+        data = rng.normal(size=(40, 28, 28, 1)).astype(np.float32)
+        preds = evaluation.eval_in_batches(eval_step, state.params, data, 16)
+        assert preds.shape == (40, 10)
+        np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-5)
+        # tail rows equal a direct forward pass on the last window
+        direct = np.asarray(eval_step(state.params, data[-16:]))
+        np.testing.assert_allclose(preds[-8:], direct[-8:], rtol=1e-5)
+
+    def test_eval_too_small_raises(self, mesh8, setup):
+        cfg, model, state, *_ = setup
+        eval_step = step.make_eval_step(model, cfg, mesh8)
+        with pytest.raises(ValueError, match="larger than dataset"):
+            evaluation.eval_in_batches(eval_step, state.params,
+                                       np.zeros((8, 28, 28, 1), np.float32), 16)
+
+    def test_shard_error_rates(self):
+        preds = np.eye(10, dtype=np.float32)[np.arange(8) % 10]
+        labels = np.arange(8) % 10
+        labels[0] = 9  # one wrong in shard 0
+        rates = evaluation.shard_error_rates(preds, labels, 4)
+        assert rates[0] == pytest.approx(50.0)
+        assert rates[1:] == [0.0, 0.0, 0.0]
