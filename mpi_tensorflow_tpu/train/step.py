@@ -85,7 +85,12 @@ def make_train_step(model, config, mesh, decay_steps: int):
         rng = jax.random.fold_in(rng, lax.axis_index("data"))
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, batch, labels, rng)
-        grads = collectives.allreduce_mean(grads, "data")
+        # shard_map autodiff inserts the gradient allreduce itself: the
+        # cotangent of the replicated params is psum'd across 'data' (this IS
+        # the reference's intended MPI.Allreduce, emitted by the transpose
+        # rule).  grads therefore hold sum_s(local-mean grad_s); normalize by
+        # the axis size to get the global-batch mean gradient.
+        grads = jax.tree.map(lambda g: g / lax.axis_size("data"), grads)
         loss = collectives.allreduce_mean(loss, "data")
         lr = schedule(state.opt.step)
         params, opt = momentum_apply(state.params, grads, state.opt, lr,

@@ -10,10 +10,18 @@ import os
 
 # Neutralize the axon TPU plugin hook (it keys off this var) and force a
 # virtual 8-device CPU platform so mesh/psum code runs 8-way with no TPU.
+# The env vars alone are not enough: a sitecustomize on this image imports
+# jax at interpreter start, baking the env into jax.config defaults — so we
+# also set the config explicitly before the backend initializes.
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["JAX_NUM_CPU_DEVICES"] = "8"
 os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

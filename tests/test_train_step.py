@@ -10,8 +10,10 @@ from mpi_tensorflow_tpu.models import cnn
 from mpi_tensorflow_tpu.train import evaluation, step
 
 
-@pytest.fixture(scope="module")
+@pytest.fixture()
 def setup(mesh8):
+    # function-scoped: train steps donate the state buffer, so each test
+    # needs a fresh one
     cfg = Config(batch_size=16, dropout_rate=0.0)  # dropout off -> exact math
     model = cnn.MnistCnn(dropout_rate=0.0)
     state = step.init_state(model, jax.random.key(1))
@@ -25,21 +27,22 @@ class TestSyncStep:
     def test_runs_and_updates(self, mesh8, setup):
         cfg, model, state, batch, labels = setup
         train_step = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
+        old_fc2 = np.asarray(state.params["fc2_w"])  # state buffer is donated
         new_state, metrics = train_step(state, batch, labels, jax.random.key(0))
         assert float(metrics["loss"]) > 0
         assert float(metrics["lr"]) == pytest.approx(cfg.base_lr)
         assert float(new_state.opt.step) == 1.0
         # params moved
-        assert not np.allclose(new_state.params["fc2_w"], state.params["fc2_w"])
+        assert not np.allclose(new_state.params["fc2_w"], old_fc2)
 
     def test_matches_single_device_sgd(self, mesh8, setup):
         """8-way data-parallel pmean-of-grads == single-device full-batch SGD.
         This is the correctness contract of the psum path."""
         cfg, model, state, batch, labels = setup
         train_step = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
-        multi, _ = train_step(state, batch, labels, jax.random.key(0))
 
-        # single device reference: plain value_and_grad on the full batch
+        # single device reference first (train_step donates the state buffer):
+        # plain value_and_grad on the full batch
         loss_fn = step.make_loss_fn(model, cfg)
         from mpi_tensorflow_tpu.train import optimizer as opt
         grads = jax.grad(loss_fn)(state.params, jnp.array(batch),
@@ -48,6 +51,9 @@ class TestSyncStep:
                                    cfg.batch_size, 1000, cfg.lr_decay)
         want_params, _ = opt.momentum_apply(state.params, grads, state.opt,
                                             lr, cfg.momentum)
+        want_params = jax.tree.map(np.asarray, want_params)
+
+        multi, _ = train_step(state, batch, labels, jax.random.key(0))
         for k in want_params:
             np.testing.assert_allclose(multi.params[k], want_params[k],
                                        rtol=1e-5, atol=1e-6)
@@ -55,8 +61,9 @@ class TestSyncStep:
     def test_deterministic(self, mesh8, setup):
         cfg, model, state, batch, labels = setup
         train_step = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
+        state2 = jax.tree.map(jnp.copy, state)  # each call donates its input
         a, _ = train_step(state, batch, labels, jax.random.key(0))
-        b, _ = train_step(state, batch, labels, jax.random.key(0))
+        b, _ = train_step(state2, batch, labels, jax.random.key(0))
         for k in a.params:
             np.testing.assert_array_equal(a.params[k], b.params[k])
 
