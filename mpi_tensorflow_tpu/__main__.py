@@ -1,0 +1,3 @@
+from mpi_tensorflow_tpu.cli import main
+
+raise SystemExit(main())

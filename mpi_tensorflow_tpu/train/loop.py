@@ -1,0 +1,156 @@
+"""The host training loop — ``Cnn.run_process`` rebuilt (mpipy.py:76-93).
+
+Semantics preserved from the reference:
+- per-shard steps: ``epochs * local_train_size // batch_size`` (mpipy.py:79);
+- sequential wraparound batching per shard, no shuffling (mpipy.py:80-82) —
+  the global batch each step is the concatenation of every shard's 64-row
+  window, exactly the rows the N MPI ranks would each slice;
+- LR decay_steps = local train size (mpipy.py:62);
+- the 50-step console trace, one line per shard (mpipy.py:87-90);
+- parameter sync on the trace cadence in ``avg50`` mode (mpipy.py:91).
+
+Deliberate divergences (documented in SURVEY.md §7):
+- evaluation runs on the trace cadence, OFF the timed path — the reference
+  evaluates the full test shard EVERY step (mpipy.py:86), an accidental cost
+  excluded by BASELINE.md's measurement rule;
+- ``psum`` mode replaces the reference's rank-0-only periodic averaging with
+  per-step gradient allreduce (true synchronous SGD).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.data import mnist
+from mpi_tensorflow_tpu.data.idx import error_rate
+from mpi_tensorflow_tpu.models import cnn as cnn_lib
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
+from mpi_tensorflow_tpu.train import evaluation, step as step_lib
+from mpi_tensorflow_tpu.utils import logging as logs
+from mpi_tensorflow_tpu.utils.timing import StepTimer
+
+
+@dataclasses.dataclass
+class TrainResult:
+    state: Any
+    history: list          # [(step, global_test_error), ...]
+    final_test_error: float
+    images_per_sec: float
+    step_time_seconds: float
+    num_devices: int
+    num_steps: int
+
+
+def build_model(config: Config):
+    if config.model == "mnist_cnn":
+        return cnn_lib.MnistCnn(
+            image_size=config.image_size,
+            num_channels=config.num_channels,
+            num_classes=config.num_classes,
+            dropout_rate=config.dropout_rate,
+        )
+    if config.model in ("resnet20", "resnet50"):
+        from mpi_tensorflow_tpu.models import resnet
+
+        return resnet.build(config.model, num_classes=config.num_classes)
+    if config.model == "bert_base":
+        from mpi_tensorflow_tpu.models import bert
+
+        return bert.BertMlm(bert.BERT_BASE)
+    raise ValueError(f"unknown model {config.model!r}")
+
+
+def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
+          mesh=None, verbose: bool = True) -> TrainResult:
+    """End-to-end data-parallel training (the ``main()`` + ``Cnn`` path of
+    the reference, mpipy.py:201-244, minus MPI)."""
+    mesh = mesh if mesh is not None else meshlib.make_mesh(config.mesh_shape)
+    ndev = meshlib.data_axis_size(mesh)
+    model = model if model is not None else build_model(config)
+    if splits is None:
+        splits = mnist.load_splits(config.data_dir, num_shards=ndev)
+    b = config.batch_size
+
+    # per-shard contiguous layout: shard i <- rows [i*localN, (i+1)*localN)
+    local_n = splits.train_labels.shape[0] // ndev
+    if local_n <= b:
+        raise ValueError(f"local train size {local_n} must exceed batch {b}")
+    tr_d = splits.train_data[:local_n * ndev].reshape(
+        ndev, local_n, *splits.train_data.shape[1:])
+    tr_l = splits.train_labels[:local_n * ndev].reshape(ndev, local_n)
+    num_steps = config.epochs * local_n // b          # mpipy.py:79
+    global_b = b * ndev
+
+    state = step_lib.init_state(model, jax.random.key(config.seed))
+    if config.sync == "psum":
+        train_step = step_lib.make_train_step(model, config, mesh,
+                                              decay_steps=local_n)
+        eval_step = step_lib.make_eval_step(model, config, mesh)
+        get_eval_params = lambda s: s.params
+    elif config.sync == "avg50":
+        train_step = step_lib.make_local_train_step(model, config, mesh,
+                                                    decay_steps=local_n)
+        avg_step = step_lib.make_average_step(mesh)
+        eval_step = step_lib.make_stacked_eval_step(model, config, mesh)
+        state = step_lib.stack_state(state, ndev)
+        get_eval_params = lambda s: s.params
+    else:
+        raise ValueError(f"unknown sync mode {config.sync!r}")
+
+    batch_sharding = NamedSharding(mesh, P("data"))
+    rng = jax.random.key(config.seed + 1)
+    timer = StepTimer(warmup_steps=1)
+    history = []
+    if verbose:
+        logs.session_start(meshlib.process_index())
+
+    def run_eval(s):
+        preds = evaluation.eval_in_batches(
+            eval_step, get_eval_params(s), splits.test_data, global_b)
+        return preds
+
+    pending = 0
+    timer.start()
+    for t in range(num_steps):
+        offset = (t * b) % (local_n - b)               # mpipy.py:80
+        batch = np.ascontiguousarray(
+            tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
+        labels = np.ascontiguousarray(
+            tr_l[:, offset:offset + b]).reshape(global_b)
+        batch = jax.device_put(batch, batch_sharding)
+        labels = jax.device_put(labels, batch_sharding)
+        state, metrics = train_step(state, batch, labels, rng)
+        pending += 1
+
+        last = t == num_steps - 1
+        if (t > 0 and t % config.log_every == 0) or last:
+            jax.block_until_ready(state)               # close the timed span
+            timer.stop(pending)
+            pending = 0
+            preds = run_eval(state)
+            global_err = error_rate(preds, splits.test_labels)
+            history.append((t, global_err))
+            if verbose:
+                # one line per shard, the reference's per-rank trace
+                for r, e in enumerate(evaluation.shard_error_rates(
+                        preds, splits.test_labels, ndev)):
+                    logs.step_trace(r, t, e)
+            if config.sync == "avg50" and not last:    # mpipy.py:91
+                state = avg_step(state)
+            timer.start()
+
+    final_err = history[-1][1] if history else float("nan")
+    ips = timer.images_per_sec(global_b)
+    if verbose:
+        logs.timing_summary(ips, timer.mean_step_seconds * 1e3, ndev)
+    return TrainResult(
+        state=state, history=history, final_test_error=final_err,
+        images_per_sec=ips, step_time_seconds=timer.mean_step_seconds,
+        num_devices=ndev, num_steps=num_steps,
+    )

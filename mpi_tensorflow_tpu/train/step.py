@@ -81,8 +81,10 @@ def make_train_step(model, config, mesh, decay_steps: int):
     loss_fn = make_loss_fn(model, config)
 
     def step(state: TrainState, batch, labels, rng):
-        # distinct dropout stream per shard and per step
+        # distinct dropout stream per shard and per step (derived in-graph —
+        # the host passes one base key for the whole run)
         rng = jax.random.fold_in(rng, lax.axis_index("data"))
+        rng = jax.random.fold_in(rng, state.opt.step.astype(jnp.int32))
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, batch, labels, rng)
         # shard_map autodiff inserts the gradient allreduce itself: the
@@ -117,6 +119,19 @@ def make_eval_step(model, config, mesh):
     return jax.jit(sharded)
 
 
+def make_stacked_eval_step(model, config, mesh):
+    """Eval for avg50 mode: each shard predicts with its OWN diverged params
+    (each MPI rank evaluates its own replica in the reference)."""
+
+    def fwd(params, batch):
+        params = jax.tree.map(lambda x: x[0], params)
+        return jax.nn.softmax(model.apply(params, batch, train=False))
+
+    sharded = jax.shard_map(
+        fwd, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"))
+    return jax.jit(sharded)
+
+
 # --------------------------------------------------------------------------
 # avg50 fidelity mode: independent per-shard SGD + periodic averaging
 # --------------------------------------------------------------------------
@@ -141,6 +156,7 @@ def make_local_train_step(model, config, mesh, decay_steps: int):
     def step(state: TrainState, batch, labels, rng):
         state = jax.tree.map(lambda x: x[0], state)  # strip shard axis block
         rng = jax.random.fold_in(rng, lax.axis_index("data"))
+        rng = jax.random.fold_in(rng, state.opt.step.astype(jnp.int32))
         loss, grads = jax.value_and_grad(loss_fn)(
             state.params, batch, labels, rng)
         lr = schedule(state.opt.step)

@@ -1,0 +1,84 @@
+"""Step-time / throughput measurement harness.
+
+The reference has no timing at all (commented-out ``time.time()`` at
+mpipy.py:78), yet the project's north-star metric is images/sec/chip
+(BASELINE.json).  Measurement rule from BASELINE.md: evaluation stays OFF the
+timed path — the reference's accidental every-step full-test eval
+(mpipy.py:86) must not be replicated in what we time.
+
+Asynchronous dispatch: JAX returns before the device finishes, so the timer
+blocks on the final output (``block_until_ready``) and amortizes over many
+steps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+
+@dataclasses.dataclass
+class StepTimer:
+    """Accumulates steady-state step wall time, skipping warmup steps
+    (compile + first dispatches)."""
+    warmup_steps: int = 2
+    _steps: int = 0
+    _total: float = 0.0
+    _t0: float = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, count: int = 1) -> None:
+        dt = time.perf_counter() - self._t0
+        if self.warmup_steps > 0:
+            self.warmup_steps -= count
+            return
+        self._steps += count
+        self._total += dt
+
+    @property
+    def steps_timed(self) -> int:
+        return self._steps
+
+    @property
+    def mean_step_seconds(self) -> float:
+        return self._total / self._steps if self._steps else float("nan")
+
+    def images_per_sec(self, batch_size: int) -> float:
+        s = self.mean_step_seconds
+        return batch_size / s if s == s and s > 0 else float("nan")
+
+
+def time_fn(fn, *args, iters: int = 20, warmup: int = 3):
+    """Benchmark a jitted function: returns mean seconds/call, blocking on
+    outputs.  Donated-input functions must be passed arg factories instead —
+    see ``time_step_fn``."""
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def time_step_fn(step_fn, state, make_args, iters: int = 20, warmup: int = 3):
+    """Benchmark a train step that donates (and returns) its state.
+
+    ``make_args(i)`` supplies the per-call non-state arguments.  Returns
+    ``(mean_seconds_per_step, final_state)``.
+    """
+    import jax
+
+    for i in range(warmup):
+        state, metrics = step_fn(state, *make_args(i))
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, metrics = step_fn(state, *make_args(i))
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters, state

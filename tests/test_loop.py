@@ -1,0 +1,82 @@
+"""End-to-end loop tests: the minimum end-to-end slice of SURVEY.md §7,
+including the short-run convergence check (§4)."""
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.data import mnist
+from mpi_tensorflow_tpu.train import loop
+
+
+@pytest.fixture(scope="module")
+def splits(mnist_dir):
+    return mnist.load_splits(mnist_dir, num_shards=8, train_n=1200, test_n=256)
+
+
+def small_config(**kw):
+    base = dict(epochs=2, batch_size=8, log_every=10, seed=1)
+    base.update(kw)
+    return Config(**base)
+
+
+class TestTrainLoop:
+    def test_psum_end_to_end_converges(self, mesh8, splits):
+        cfg = small_config(epochs=4)
+        res = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        assert res.num_devices == 8
+        assert res.num_steps == 4 * (splits.train_labels.shape[0] // 8) // 8
+        assert len(res.history) >= 2
+        # synthetic blobs are separable: error should fall well below chance
+        assert res.final_test_error < 30.0
+        errs = [e for _, e in res.history]
+        assert res.final_test_error <= errs[0]
+
+    def test_avg50_mode_runs(self, mesh8, splits):
+        cfg = small_config(sync="avg50")
+        res = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        assert np.isfinite(res.final_test_error)
+        # stacked state: leading shard axis present
+        assert res.state.params["fc2_w"].shape[0] == 8
+
+    def test_timing_populated(self, mesh8, splits):
+        cfg = small_config()
+        res = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        assert res.images_per_sec > 0
+        assert res.step_time_seconds > 0
+
+    def test_trace_format(self, mesh8, splits, capsys):
+        cfg = small_config()
+        loop.train(cfg, splits=splits, mesh=mesh8, verbose=True)
+        out = capsys.readouterr().out
+        # the reference's exact line shapes (mpipy.py:77, 88)
+        assert "training session starts!" in out
+        assert " process at " in out
+        assert "with test error:" in out
+        assert "[timing]" in out
+
+    def test_determinism_same_seed(self, mesh8, splits):
+        cfg = small_config(epochs=1)
+        r1 = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        r2 = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        assert r1.history == r2.history  # SURVEY.md §4 determinism test
+
+
+class TestCli:
+    def test_zero_flag_defaults(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args([])
+        cfg = cli.config_from_args(args)
+        # the reference's constants (mpipy.py:18-21)
+        assert cfg.epochs == 2
+        assert cfg.batch_size == 64
+        assert cfg.image_size == 28
+        assert cfg.num_classes == 10
+        assert cfg.sync == "psum"
+
+    def test_mesh_parse(self):
+        from mpi_tensorflow_tpu import cli
+
+        assert cli.parse_mesh("data=4,model=2") == {"data": 4, "model": 2}
+        assert cli.parse_mesh(None) is None
