@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the north-star metric.
+
+Metric (BASELINE.json): images/sec/chip on the MNIST CNN train step, with
+evaluation OFF the timed path (BASELINE.md measurement rule — the reference's
+loop hides a full test-shard eval in every step, mpipy.py:86).
+
+``vs_baseline`` compares against the single-process reference-semantics
+baseline recorded in BASELINE_MEASURED.json (the reference publishes no
+numbers; BASELINE.md directs this project to establish them).  Regenerate the
+baseline with ``python bench.py --record-baseline`` on the baseline host.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BASELINE_MEASURED.json")
+
+
+def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5) -> dict:
+    import jax
+    import numpy as np
+
+    from mpi_tensorflow_tpu.config import Config
+    from mpi_tensorflow_tpu.models.cnn import MnistCnn
+    from mpi_tensorflow_tpu.parallel import mesh as meshlib
+    from mpi_tensorflow_tpu.train import step as step_lib
+    from mpi_tensorflow_tpu.utils.timing import time_step_fn
+
+    cfg = Config(batch_size=batch_size)
+    mesh = meshlib.make_mesh()
+    ndev = meshlib.data_axis_size(mesh)
+    global_b = batch_size * ndev
+
+    model = MnistCnn()
+    state = step_lib.init_state(model, jax.random.key(cfg.seed))
+    train_step = step_lib.make_train_step(model, cfg, mesh, decay_steps=50000)
+
+    rng = np.random.default_rng(0)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sh = NamedSharding(mesh, P("data"))
+    n_banks = 4  # rotate buffers so steps don't alias one input
+    batches = [jax.device_put(
+        rng.normal(size=(global_b, 28, 28, 1)).astype(np.float32) * 0.3, sh)
+        for _ in range(n_banks)]
+    labels = [jax.device_put(
+        rng.integers(0, 10, size=(global_b,)).astype(np.int64), sh)
+        for _ in range(n_banks)]
+    key = jax.random.key(0)
+
+    sec_per_step, _ = time_step_fn(
+        train_step, state,
+        lambda i: (batches[i % n_banks], labels[i % n_banks], key),
+        iters=steps, warmup=warmup)
+
+    return {
+        "images_per_sec": global_b / sec_per_step,
+        "images_per_sec_per_chip": batch_size / sec_per_step,
+        "step_time_ms": sec_per_step * 1e3,
+        "num_devices": ndev,
+        "batch_size_per_chip": batch_size,
+        "platform": jax.devices()[0].platform,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record-baseline", action="store_true",
+                    help="store this run as the comparison baseline "
+                         "(reference-semantics single-process measurement)")
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args(argv)
+
+    result = measure(batch_size=args.batch_size, steps=args.steps)
+
+    if args.record_baseline:
+        with open(BASELINE_FILE, "w") as f:
+            json.dump(result, f, indent=2)
+        print(json.dumps({"recorded_baseline": result}))
+        return 0
+
+    vs = float("nan")
+    if os.path.exists(BASELINE_FILE):
+        with open(BASELINE_FILE) as f:
+            base = json.load(f)
+        if base.get("images_per_sec_per_chip"):
+            vs = result["images_per_sec_per_chip"] / base["images_per_sec_per_chip"]
+
+    print(json.dumps({
+        "metric": "MNIST CNN train-step throughput (eval off timed path)",
+        "value": round(result["images_per_sec_per_chip"], 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3) if vs == vs else None,
+        "detail": result,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
