@@ -1,0 +1,58 @@
+"""CIFAR-10 pipeline (BASELINE.json config 3: ResNet-20 data-parallel).
+
+Attempts the real binary distribution; in air-gapped environments falls back
+to deterministic synthetic data of the same shapes (32x32x3, 10 classes) —
+see ``data.synthetic`` for why that is sufficient for the benchmark role.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+from mpi_tensorflow_tpu.data.mnist import Splits
+from mpi_tensorflow_tpu.data import synthetic
+
+CIFAR_URL = "https://www.cs.toronto.edu/~kriz/cifar-10-binary.tar.gz"
+_REC = 3073  # 1 label byte + 3072 pixel bytes
+
+
+def _parse_bin(path: str) -> tuple[np.ndarray, np.ndarray]:
+    raw = np.frombuffer(open(path, "rb").read(), dtype=np.uint8)
+    raw = raw.reshape(-1, _REC)
+    labels = raw[:, 0].astype(np.int64)
+    # stored CHW planar -> NHWC, normalized like MNIST: (p - 127.5)/255
+    imgs = raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+    imgs = (imgs.astype(np.float32) - 127.5) / 255.0
+    return imgs, labels
+
+
+def load_splits(data_dir: str = "./data", train_n: int | None = None,
+                test_n: int | None = None) -> Splits:
+    bin_dir = os.path.join(data_dir, "cifar-10-batches-bin")
+    if not os.path.isdir(bin_dir):
+        os.makedirs(data_dir, exist_ok=True)
+        tgz = os.path.join(data_dir, "cifar-10-binary.tar.gz")
+        try:
+            if not os.path.exists(tgz):
+                urllib.request.urlretrieve(CIFAR_URL, tgz)
+            with tarfile.open(tgz) as tf:
+                tf.extractall(data_dir)
+        except (urllib.error.URLError, OSError):
+            return synthetic.image_classification(
+                train_n or 50000, test_n or 10000,
+                size=32, channels=3, num_classes=10)
+    tr = [_parse_bin(os.path.join(bin_dir, f"data_batch_{i}.bin"))
+          for i in range(1, 6)]
+    tr_x = np.concatenate([x for x, _ in tr])[:train_n]
+    tr_y = np.concatenate([y for _, y in tr])[:train_n]
+    ts_x, ts_y = _parse_bin(os.path.join(bin_dir, "test_batch.bin"))
+    ts_x, ts_y = ts_x[:test_n], ts_y[:test_n]
+    val_n = max(tr_x.shape[0] // 12, 1)
+    return Splits(train_data=tr_x[val_n:], train_labels=tr_y[val_n:],
+                  test_data=ts_x, test_labels=ts_y,
+                  val_data=tr_x[:val_n], val_labels=tr_y[:val_n])

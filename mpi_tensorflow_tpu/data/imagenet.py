@@ -1,0 +1,35 @@
+"""ImageNet-shaped pipeline (BASELINE.json config 4: ResNet-50 on v4-32).
+
+ImageNet itself cannot be auto-downloaded; this module serves the benchmark
+role with deterministic synthetic 224x224x3/1000-class data, and accepts a
+user-provided directory of pre-processed ``.npy`` shards for real runs.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from mpi_tensorflow_tpu.data.mnist import Splits
+from mpi_tensorflow_tpu.data import synthetic
+
+IMAGE_SIZE = 224
+NUM_CLASSES = 1000
+
+
+def load_splits(data_dir: str = "./data", train_n: int = 2048,
+                test_n: int = 512, image_size: int = IMAGE_SIZE) -> Splits:
+    np_dir = os.path.join(data_dir, "imagenet_npy")
+    if os.path.isdir(np_dir):
+        tr_x = np.load(os.path.join(np_dir, "train_images.npy"), mmap_mode="r")
+        tr_y = np.load(os.path.join(np_dir, "train_labels.npy"))
+        ts_x = np.load(os.path.join(np_dir, "val_images.npy"), mmap_mode="r")
+        ts_y = np.load(os.path.join(np_dir, "val_labels.npy"))
+        val_n = max(tr_x.shape[0] // 12, 1)
+        return Splits(train_data=tr_x[val_n:], train_labels=tr_y[val_n:],
+                      test_data=ts_x, test_labels=ts_y,
+                      val_data=tr_x[:val_n], val_labels=tr_y[:val_n])
+    return synthetic.image_classification(
+        train_n, test_n, size=image_size, channels=3,
+        num_classes=NUM_CLASSES)

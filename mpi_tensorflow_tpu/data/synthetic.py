@@ -1,0 +1,70 @@
+"""Deterministic synthetic datasets for scale-out configs.
+
+The BASELINE.json configs 3-5 (CIFAR-10 ResNet-20, ImageNet ResNet-50,
+BERT MLM) are throughput benchmarks — the gradient/allreduce payload and the
+step math are what's measured, so learnable synthetic data of the real shapes
+is sufficient in an air-gapped environment (and keeps runs reproducible).
+Images get a class-dependent signal so short convergence tests can verify the
+training loop actually learns.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from mpi_tensorflow_tpu.data.mnist import Splits
+
+
+def image_classification(train_n: int, test_n: int, *, size: int,
+                         channels: int, num_classes: int,
+                         seed: int = 0) -> Splits:
+    """Class-separable images in ``[-0.5, 0.5]`` (same normalization as the
+    MNIST pipeline, mpipy.py:230 buffers), labels int64."""
+    rng = np.random.default_rng(seed)
+
+    def make(n):
+        labels = rng.integers(0, num_classes, size=n).astype(np.int64)
+        x = rng.normal(0.0, 0.15, size=(n, size, size, channels))
+        # class signal: a low-frequency pattern per class
+        freqs = 1 + (np.arange(num_classes) % 4)
+        phases = 2 * np.pi * np.arange(num_classes) / num_classes
+        t = np.linspace(0, 2 * np.pi, size)
+        for c in range(num_classes):
+            mask = labels == c
+            pattern = 0.25 * np.outer(np.sin(freqs[c] * t + phases[c]),
+                                      np.cos(freqs[c] * t))
+            x[mask] += pattern[None, :, :, None]
+        return np.clip(x, -0.5, 0.5).astype(np.float32), labels
+
+    tr_x, tr_y = make(train_n)
+    ts_x, ts_y = make(test_n)
+    val_n = max(train_n // 12, 1)
+    return Splits(
+        train_data=tr_x[val_n:], train_labels=tr_y[val_n:],
+        test_data=ts_x, test_labels=ts_y,
+        val_data=tr_x[:val_n], val_labels=tr_y[:val_n],
+    )
+
+
+def mlm_batches(num_examples: int, *, seq_len: int, vocab_size: int,
+                mask_token: int = 4, mask_rate: float = 0.15,
+                seed: int = 0):
+    """Synthetic masked-LM data: token sequences with local structure
+    (next-token correlation) so MLM loss is reducible.
+
+    Returns ``(tokens, targets, mask_positions)`` with tokens already masked:
+    ``tokens`` int32 (N, S) input ids, ``targets`` int32 (N, S) original ids,
+    ``mask`` bool (N, S) True where the loss applies.
+    """
+    rng = np.random.default_rng(seed)
+    # Markov-ish stream: next token = (prev + step) % vocab with noise
+    steps = rng.integers(1, 7, size=(num_examples, 1))
+    start = rng.integers(5, vocab_size, size=(num_examples, 1))
+    pos = np.arange(seq_len)[None, :]
+    clean = (start + steps * pos) % (vocab_size - 5) + 5
+    noise = rng.random((num_examples, seq_len)) < 0.05
+    clean = np.where(noise,
+                     rng.integers(5, vocab_size, size=clean.shape), clean)
+    mask = rng.random((num_examples, seq_len)) < mask_rate
+    tokens = np.where(mask, mask_token, clean)
+    return (tokens.astype(np.int32), clean.astype(np.int32), mask)

@@ -43,3 +43,24 @@ def l2_loss(x) -> Any:
     import jax.numpy as jnp
 
     return jnp.sum(jnp.square(x)) / 2.0
+
+
+def init_model_state(model) -> Any:
+    """Mutable (non-trained) model state — e.g. BatchNorm running statistics.
+
+    Stateless models (the reference CNN, BERT) return ``{}``; models that
+    track statistics define ``init_state()``.
+    """
+    if hasattr(model, "init_state"):
+        return model.init_state()
+    return {}
+
+
+def run_model(model, params, model_state, inputs, *, train: bool,
+              rng=None):
+    """Uniform forward entry: returns ``(outputs, new_model_state)`` whether
+    or not the model carries state."""
+    if hasattr(model, "apply_with_state"):
+        return model.apply_with_state(params, model_state, inputs,
+                                      train=train, rng=rng)
+    return model.apply(params, inputs, train=train, rng=rng), model_state

@@ -1,0 +1,205 @@
+"""ResNet family — BASELINE.json scale-out configs 3 and 4.
+
+The reference has exactly one model (the 2-conv MNIST CNN, mpipy.py:155-167);
+BASELINE.json directs scaling the *identical* train loop to CIFAR-10
+ResNet-20 and ImageNet ResNet-50 — "same train-step/loop, bigger models
+(stressing allreduce payload)" (SURVEY.md §7 capability 6).  These models
+plug into the framework's ``Model`` protocol unchanged: the loop and step
+code do not know which model they run.
+
+Variants:
+- ``resnet20``: the CIFAR ResNet (He et al. 2016, section 4.2): 3x3 stem,
+  3 stages x 3 basic blocks, widths 16/32/64, identity shortcuts with
+  stride-2 projections.
+- ``resnet50``: ImageNet bottleneck ResNet: 7x7/2 stem + 3x3/2 maxpool,
+  stages [3, 4, 6, 3] of bottleneck blocks, widths 256/512/1024/2048.
+
+TPU notes: NHWC throughout; He-normal init; BN running stats in
+``model_state`` (averaged across data shards by the train step); weight
+decay applies to conv/fc weights (standard ResNet practice) via
+``l2_params``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from mpi_tensorflow_tpu.ops import nn
+
+
+def _he_normal(key, shape):
+    """He/Kaiming normal for relu nets: std = sqrt(2 / fan_in)."""
+    fan_in = int(jnp.prod(jnp.asarray(shape[:-1])))
+    return jax.random.normal(key, shape) * jnp.sqrt(2.0 / fan_in)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNet:
+    stage_sizes: Sequence[int]
+    widths: Sequence[int]
+    bottleneck: bool
+    num_classes: int = 10
+    cifar_stem: bool = True          # 3x3/1 stem (CIFAR) vs 7x7/2 + pool
+    bn_momentum: float = 0.9
+
+    # ---- init ----
+
+    def init(self, rng):
+        keys = iter(jax.random.split(rng, 4096))
+        params = {"stem": {"w": _he_normal(next(keys), self._stem_shape()),
+                           "bn": nn.bn_init(self._stem_width())}}
+        in_w = self._stem_width()
+        stages = []
+        for s, (n_blocks, width) in enumerate(zip(self.stage_sizes, self.widths)):
+            blocks = []
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                blocks.append(self._block_init(keys, in_w, width, stride))
+                in_w = width
+            stages.append(blocks)
+        params["stages"] = stages
+        params["fc"] = {
+            "w": jax.random.normal(next(keys), (in_w, self.num_classes)) * 0.01,
+            "b": jnp.zeros((self.num_classes,)),
+        }
+        return params
+
+    def init_state(self):
+        state = {"stem": nn.bn_state_init(self._stem_width())}
+        in_w = self._stem_width()
+        stages = []
+        for s, (n_blocks, width) in enumerate(zip(self.stage_sizes, self.widths)):
+            blocks = []
+            for b in range(n_blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                blocks.append(self._block_state(in_w, width, stride))
+                in_w = width
+            stages.append(blocks)
+        state["stages"] = stages
+        return state
+
+    def _stem_shape(self):
+        return (3, 3, 3, 16) if self.cifar_stem else (7, 7, 3, 64)
+
+    def _stem_width(self):
+        return 16 if self.cifar_stem else 64
+
+    def _mid(self, width):
+        return width // 4 if self.bottleneck else width
+
+    def _block_init(self, keys, in_w, width, stride):
+        mid = self._mid(width)
+        if self.bottleneck:
+            p = {
+                "conv1": _he_normal(next(keys), (1, 1, in_w, mid)),
+                "bn1": nn.bn_init(mid),
+                "conv2": _he_normal(next(keys), (3, 3, mid, mid)),
+                "bn2": nn.bn_init(mid),
+                "conv3": _he_normal(next(keys), (1, 1, mid, width)),
+                "bn3": nn.bn_init(width),
+            }
+        else:
+            p = {
+                "conv1": _he_normal(next(keys), (3, 3, in_w, mid)),
+                "bn1": nn.bn_init(mid),
+                "conv2": _he_normal(next(keys), (3, 3, mid, width)),
+                "bn2": nn.bn_init(width),
+            }
+        if stride != 1 or in_w != width:
+            p["proj"] = _he_normal(next(keys), (1, 1, in_w, width))
+            p["bn_proj"] = nn.bn_init(width)
+        return p
+
+    def _block_state(self, in_w, width, stride):
+        mid = self._mid(width)
+        if self.bottleneck:
+            s = {"bn1": nn.bn_state_init(mid), "bn2": nn.bn_state_init(mid),
+                 "bn3": nn.bn_state_init(width)}
+        else:
+            s = {"bn1": nn.bn_state_init(mid), "bn2": nn.bn_state_init(width)}
+        if stride != 1 or in_w != width:
+            s["bn_proj"] = nn.bn_state_init(width)
+        return s
+
+    # ---- forward ----
+
+    def apply_with_state(self, params, state, x, *, train: bool = False,
+                         rng=None):
+        mom = self.bn_momentum
+        new_state = {"stages": []}
+        stride = 1 if self.cifar_stem else 2
+        h = nn.conv2d(x, params["stem"]["w"], stride=stride)
+        h, new_state["stem"] = nn.batch_norm(
+            h, params["stem"]["bn"], state["stem"], train=train, momentum=mom)
+        h = jax.nn.relu(h)
+        if not self.cifar_stem:
+            h = nn.max_pool(h, window=3, stride=2)
+
+        for s, blocks in enumerate(params["stages"]):
+            st_out = []
+            for b, bp in enumerate(blocks):
+                stride = 2 if (b == 0 and s > 0) else 1
+                h, bs = self._block_apply(bp, state["stages"][s][b], h,
+                                          stride, train, mom)
+                st_out.append(bs)
+            new_state["stages"].append(st_out)
+
+        h = nn.global_avg_pool(h)
+        logits = h @ params["fc"]["w"] + params["fc"]["b"]
+        return logits, new_state
+
+    def _block_apply(self, p, s, x, stride, train, mom):
+        ns = {}
+        shortcut = x
+        if "proj" in p:
+            shortcut = nn.conv2d(x, p["proj"], stride=stride)
+            shortcut, ns["bn_proj"] = nn.batch_norm(
+                shortcut, p["bn_proj"], s["bn_proj"], train=train, momentum=mom)
+        if self.bottleneck:
+            h = nn.conv2d(x, p["conv1"], stride=1)
+            h, ns["bn1"] = nn.batch_norm(h, p["bn1"], s["bn1"], train=train,
+                                         momentum=mom)
+            h = jax.nn.relu(h)
+            h = nn.conv2d(h, p["conv2"], stride=stride)
+            h, ns["bn2"] = nn.batch_norm(h, p["bn2"], s["bn2"], train=train,
+                                         momentum=mom)
+            h = jax.nn.relu(h)
+            h = nn.conv2d(h, p["conv3"], stride=1)
+            h, ns["bn3"] = nn.batch_norm(h, p["bn3"], s["bn3"], train=train,
+                                         momentum=mom)
+        else:
+            h = nn.conv2d(x, p["conv1"], stride=stride)
+            h, ns["bn1"] = nn.batch_norm(h, p["bn1"], s["bn1"], train=train,
+                                         momentum=mom)
+            h = jax.nn.relu(h)
+            h = nn.conv2d(h, p["conv2"], stride=1)
+            h, ns["bn2"] = nn.batch_norm(h, p["bn2"], s["bn2"], train=train,
+                                         momentum=mom)
+        return jax.nn.relu(h + shortcut), ns
+
+    # ---- regularization ----
+
+    def l2_params(self, params) -> list:
+        """Conv + fc weights (not BN scales/offsets) — standard ResNet WD."""
+        out = [params["stem"]["w"], params["fc"]["w"]]
+        for blocks in params["stages"]:
+            for p in blocks:
+                out.extend(v for k, v in p.items()
+                           if k.startswith("conv") or k == "proj")
+        return out
+
+
+def build(name: str, num_classes: int | None = None) -> ResNet:
+    if name == "resnet20":
+        return ResNet(stage_sizes=(3, 3, 3), widths=(16, 32, 64),
+                      bottleneck=False, num_classes=num_classes or 10,
+                      cifar_stem=True)
+    if name == "resnet50":
+        return ResNet(stage_sizes=(3, 4, 6, 3),
+                      widths=(256, 512, 1024, 2048), bottleneck=True,
+                      num_classes=num_classes or 1000, cifar_stem=False)
+    raise ValueError(f"unknown resnet variant {name!r}")

@@ -1,0 +1,71 @@
+"""Reusable NN building blocks (NHWC, MXU-friendly).
+
+The reference leans on the TF runtime's fused kernels (conv/pool/matmul via
+``tf.nn.*``, mpipy.py:155-167).  Here the same roles are covered by XLA
+primitives that tile directly onto the TPU MXU, shared across model families.
+BatchNorm follows the standard training/inference split with running
+statistics carried in the framework's ``model_state`` pytree.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def conv2d(x, w, stride: int = 1, padding: str = "SAME"):
+    """NHWC/HWIO conv, stride symmetric."""
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def max_pool(x, window: int = 2, stride: int = 2, padding: str = "SAME"):
+    return lax.reduce_window(
+        x, -jnp.inf, lax.max,
+        window_dimensions=(1, window, window, 1),
+        window_strides=(1, stride, stride, 1),
+        padding=padding,
+    )
+
+
+def global_avg_pool(x):
+    """(N, H, W, C) -> (N, C)."""
+    return jnp.mean(x, axis=(1, 2))
+
+
+def bn_init(channels: int) -> dict:
+    """Trainable BN params: scale (gamma) and offset (beta)."""
+    return {"scale": jnp.ones((channels,)), "offset": jnp.zeros((channels,))}
+
+
+def bn_state_init(channels: int) -> dict:
+    """Running statistics, tracked in model_state (not trained)."""
+    return {"mean": jnp.zeros((channels,)), "var": jnp.ones((channels,))}
+
+
+def batch_norm(x, params: dict, state: dict, *, train: bool,
+               momentum: float = 0.9, eps: float = 1e-5):
+    """BatchNorm over (N, H, W) with running-stat EMA update.
+
+    Returns ``(y, new_state)``.  In data-parallel training each shard
+    normalizes with its per-shard batch statistics (standard DP BatchNorm);
+    the train step averages the updated running stats across shards so the
+    replicated state stays in sync.
+    """
+    axes = tuple(range(x.ndim - 1))
+    if train:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        new_state = {
+            "mean": momentum * state["mean"] + (1 - momentum) * mean,
+            "var": momentum * state["var"] + (1 - momentum) * var,
+        }
+    else:
+        mean, var = state["mean"], state["var"]
+        new_state = state
+    inv = lax.rsqrt(var + eps) * params["scale"]
+    y = (x - mean) * inv + params["offset"]
+    return y, new_state

@@ -19,9 +19,10 @@ import numpy as np
 from mpi_tensorflow_tpu.data.idx import error_rate  # re-export  # noqa: F401
 
 
-def eval_in_batches(eval_step, params, data, batch_size: int) -> np.ndarray:
-    """Run ``eval_step(params, batch) -> probs`` over ``data`` in fixed-size
-    batches, tail via overlapped final window."""
+def eval_in_batches(predict_fn, data, batch_size: int) -> np.ndarray:
+    """Run ``predict_fn(batch) -> probs`` over ``data`` in fixed-size
+    batches, tail via overlapped final window.  Bind params/model-state into
+    ``predict_fn`` before calling."""
     size = data.shape[0]
     if size < batch_size:
         raise ValueError(
@@ -30,9 +31,9 @@ def eval_in_batches(eval_step, params, data, batch_size: int) -> np.ndarray:
     for begin in range(0, size, batch_size):
         end = begin + batch_size
         if end <= size:
-            preds = np.asarray(eval_step(params, data[begin:end]))
+            preds = np.asarray(predict_fn(data[begin:end]))
         else:
-            preds = np.asarray(eval_step(params, data[-batch_size:]))[begin - size:]
+            preds = np.asarray(predict_fn(data[-batch_size:]))[begin - size:]
         if out is None:
             out = np.empty((size, preds.shape[-1]), dtype=np.float32)
         out[begin:begin + preds.shape[0]] = preds

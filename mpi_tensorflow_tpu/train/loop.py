@@ -66,6 +66,23 @@ def build_model(config: Config):
     raise ValueError(f"unknown model {config.model!r}")
 
 
+def load_dataset(config: Config, num_shards: int) -> mnist.Splits:
+    """Dataset dispatch (the reference supports exactly one dataset,
+    downloaded at mpipy.py:203-206; scale-out sets come from BASELINE.json)."""
+    if config.dataset == "mnist":
+        mnist.ensure_downloaded(config.data_dir)
+        return mnist.load_splits(config.data_dir, num_shards=num_shards)
+    if config.dataset == "cifar10":
+        from mpi_tensorflow_tpu.data import cifar
+
+        return cifar.load_splits(config.data_dir)
+    if config.dataset == "imagenet_synthetic":
+        from mpi_tensorflow_tpu.data import imagenet
+
+        return imagenet.load_splits(config.data_dir)
+    raise ValueError(f"unknown dataset {config.dataset!r} for the image loop")
+
+
 def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
           mesh=None, verbose: bool = True) -> TrainResult:
     """End-to-end data-parallel training (the ``main()`` + ``Cnn`` path of
@@ -74,7 +91,7 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     ndev = meshlib.data_axis_size(mesh)
     model = model if model is not None else build_model(config)
     if splits is None:
-        splits = mnist.load_splits(config.data_dir, num_shards=ndev)
+        splits = load_dataset(config, ndev)
     b = config.batch_size
 
     # per-shard contiguous layout: shard i <- rows [i*localN, (i+1)*localN)
@@ -92,14 +109,12 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         train_step = step_lib.make_train_step(model, config, mesh,
                                               decay_steps=local_n)
         eval_step = step_lib.make_eval_step(model, config, mesh)
-        get_eval_params = lambda s: s.params
     elif config.sync == "avg50":
         train_step = step_lib.make_local_train_step(model, config, mesh,
                                                     decay_steps=local_n)
         avg_step = step_lib.make_average_step(mesh)
         eval_step = step_lib.make_stacked_eval_step(model, config, mesh)
         state = step_lib.stack_state(state, ndev)
-        get_eval_params = lambda s: s.params
     else:
         raise ValueError(f"unknown sync mode {config.sync!r}")
 
@@ -111,9 +126,8 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         logs.session_start(meshlib.process_index())
 
     def run_eval(s):
-        preds = evaluation.eval_in_batches(
-            eval_step, get_eval_params(s), splits.test_data, global_b)
-        return preds
+        predict = lambda b: eval_step(s.params, s.model_state, b)
+        return evaluation.eval_in_batches(predict, splits.test_data, global_b)
 
     pending = 0
     timer.start()

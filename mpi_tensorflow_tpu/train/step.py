@@ -44,23 +44,28 @@ from mpi_tensorflow_tpu.train.optimizer import (
 class TrainState(NamedTuple):
     params: Any
     opt: MomentumState
+    model_state: Any = {}   # e.g. BatchNorm running stats; {} when stateless
 
 
 def init_state(model, rng) -> TrainState:
     params = model.init(rng)
-    return TrainState(params, momentum_init(params))
+    from mpi_tensorflow_tpu.models import base
+
+    return TrainState(params, momentum_init(params),
+                      base.init_model_state(model))
 
 
 def make_loss_fn(model, config):
     """Mean sparse-softmax-CE + L2 on the model's regularized subset
-    (mpipy.py:55-58)."""
+    (mpipy.py:55-58).  Returns ``(loss, new_model_state)``."""
+    from mpi_tensorflow_tpu.models import base
 
-    def loss_fn(params, batch, labels, rng):
-        logits = model.apply(params, batch, train=True, rng=rng)
-        ce = jnp.mean(
-            optax_softmax_ce(logits, labels))
+    def loss_fn(params, model_state, batch, labels, rng):
+        logits, new_state = base.run_model(model, params, model_state, batch,
+                                           train=True, rng=rng)
+        ce = jnp.mean(optax_softmax_ce(logits, labels))
         reg = config.weight_decay * sum(l2_loss(p) for p in model.l2_params(params))
-        return ce + reg
+        return ce + reg, new_state
 
     return loss_fn
 
@@ -85,8 +90,8 @@ def make_train_step(model, config, mesh, decay_steps: int):
         # the host passes one base key for the whole run)
         rng = jax.random.fold_in(rng, lax.axis_index("data"))
         rng = jax.random.fold_in(rng, state.opt.step.astype(jnp.int32))
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, batch, labels, rng)
+        (loss, new_mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.model_state, batch, labels, rng)
         # shard_map autodiff inserts the gradient allreduce itself: the
         # cotangent of the replicated params is psum'd across 'data' (this IS
         # the reference's intended MPI.Allreduce, emitted by the transpose
@@ -94,10 +99,13 @@ def make_train_step(model, config, mesh, decay_steps: int):
         # the axis size to get the global-batch mean gradient.
         grads = jax.tree.map(lambda g: g / lax.axis_size("data"), grads)
         loss = collectives.allreduce_mean(loss, "data")
+        # cross-replica batch-stat averaging keeps model state replicated
+        new_mstate = jax.tree.map(
+            lambda x: collectives.allreduce_mean(x, "data"), new_mstate)
         lr = schedule(state.opt.step)
         params, opt = momentum_apply(state.params, grads, state.opt, lr,
                                      config.momentum)
-        return TrainState(params, opt), {"loss": loss, "lr": lr}
+        return TrainState(params, opt, new_mstate), {"loss": loss, "lr": lr}
 
     sharded = jax.shard_map(
         step, mesh=mesh,
@@ -110,25 +118,33 @@ def make_train_step(model, config, mesh, decay_steps: int):
 def make_eval_step(model, config, mesh):
     """Sharded batched inference -> softmax predictions (the reference's
     ``eval_prediction``, mpipy.py:68 — minus its eval-dropout bug)."""
+    from mpi_tensorflow_tpu.models import base
 
-    def fwd(params, batch):
-        return jax.nn.softmax(model.apply(params, batch, train=False))
+    def fwd(params, model_state, batch):
+        logits, _ = base.run_model(model, params, model_state, batch,
+                                   train=False)
+        return jax.nn.softmax(logits)
 
     sharded = jax.shard_map(
-        fwd, mesh=mesh, in_specs=(P(), P("data")), out_specs=P("data"))
+        fwd, mesh=mesh, in_specs=(P(), P(), P("data")), out_specs=P("data"))
     return jax.jit(sharded)
 
 
 def make_stacked_eval_step(model, config, mesh):
     """Eval for avg50 mode: each shard predicts with its OWN diverged params
     (each MPI rank evaluates its own replica in the reference)."""
+    from mpi_tensorflow_tpu.models import base
 
-    def fwd(params, batch):
+    def fwd(params, model_state, batch):
         params = jax.tree.map(lambda x: x[0], params)
-        return jax.nn.softmax(model.apply(params, batch, train=False))
+        model_state = jax.tree.map(lambda x: x[0], model_state)
+        logits, _ = base.run_model(model, params, model_state, batch,
+                                   train=False)
+        return jax.nn.softmax(logits)
 
     sharded = jax.shard_map(
-        fwd, mesh=mesh, in_specs=(P("data"), P("data")), out_specs=P("data"))
+        fwd, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=P("data"))
     return jax.jit(sharded)
 
 
@@ -157,12 +173,12 @@ def make_local_train_step(model, config, mesh, decay_steps: int):
         state = jax.tree.map(lambda x: x[0], state)  # strip shard axis block
         rng = jax.random.fold_in(rng, lax.axis_index("data"))
         rng = jax.random.fold_in(rng, state.opt.step.astype(jnp.int32))
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state.params, batch, labels, rng)
+        (loss, new_mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, state.model_state, batch, labels, rng)
         lr = schedule(state.opt.step)
         params, opt = momentum_apply(state.params, grads, state.opt, lr,
                                      config.momentum)
-        new = TrainState(params, opt)
+        new = TrainState(params, opt, new_mstate)
         new = jax.tree.map(lambda x: x[None], new)
         return new, {"loss": loss[None], "lr": lr[None]}
 

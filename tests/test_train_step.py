@@ -45,8 +45,9 @@ class TestSyncStep:
         # plain value_and_grad on the full batch
         loss_fn = step.make_loss_fn(model, cfg)
         from mpi_tensorflow_tpu.train import optimizer as opt
-        grads = jax.grad(loss_fn)(state.params, jnp.array(batch),
-                                  jnp.array(labels), jax.random.key(9))
+        grads = jax.grad(loss_fn, has_aux=True)(
+            state.params, state.model_state, jnp.array(batch),
+            jnp.array(labels), jax.random.key(9))[0]
         lr = opt.exponential_decay(cfg.base_lr, state.opt.step,
                                    cfg.batch_size, 1000, cfg.lr_decay)
         want_params, _ = opt.momentum_apply(state.params, grads, state.opt,
@@ -102,20 +103,22 @@ class TestEval:
     def test_eval_in_batches_tail(self, mesh8, setup):
         cfg, model, state, batch, labels = setup
         eval_step = step.make_eval_step(model, cfg, mesh8)
+        predict = lambda b: eval_step(state.params, state.model_state, b)
         rng = np.random.default_rng(1)
         data = rng.normal(size=(40, 28, 28, 1)).astype(np.float32)
-        preds = evaluation.eval_in_batches(eval_step, state.params, data, 16)
+        preds = evaluation.eval_in_batches(predict, data, 16)
         assert preds.shape == (40, 10)
         np.testing.assert_allclose(preds.sum(axis=1), 1.0, rtol=1e-5)
         # tail rows equal a direct forward pass on the last window
-        direct = np.asarray(eval_step(state.params, data[-16:]))
+        direct = np.asarray(predict(data[-16:]))
         np.testing.assert_allclose(preds[-8:], direct[-8:], rtol=1e-5)
 
     def test_eval_too_small_raises(self, mesh8, setup):
         cfg, model, state, *_ = setup
         eval_step = step.make_eval_step(model, cfg, mesh8)
+        predict = lambda b: eval_step(state.params, state.model_state, b)
         with pytest.raises(ValueError, match="larger than dataset"):
-            evaluation.eval_in_batches(eval_step, state.params,
+            evaluation.eval_in_batches(predict,
                                        np.zeros((8, 28, 28, 1), np.float32), 16)
 
     def test_shard_error_rates(self):
