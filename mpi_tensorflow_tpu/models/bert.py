@@ -1,0 +1,238 @@
+"""BERT-base masked-LM — BASELINE.json config 5 ("stress allreduce bandwidth").
+
+The reference has no transformer, no attention, no sequence axis (SURVEY.md
+§2 checklist); BERT-MLM is the directed scale-out family that exercises the
+framework's transformer stack: multi-axis sharding (DP x TP x SP) and ring
+attention for long sequences.
+
+Architecture: original BERT-base encoder (post-LN): token+position
+embeddings -> 12 x [MHA + residual/LN, GELU-MLP + residual/LN] -> tied-weight
+MLM head over the vocab.  Hyperparameters configurable; ``BERT_BASE`` is the
+canonical 110M-param config.
+
+Sharding (parallel/sharding_rules.py, Megatron layout):
+- attention QKV column-parallel over ``model`` (heads sharded), output
+  projection row-parallel;
+- MLP in column-parallel / out row-parallel over ``model``;
+- embedding + LM head vocab-parallel over ``model``;
+- activations batch-sharded over ``data``, sequence-sharded over ``seq``;
+- attention runs as ring attention (parallel/ring.py) via an inner
+  ``shard_map`` when the mesh has a ``seq`` axis >1, dense otherwise.
+All other collectives are inserted by XLA GSPMD from the constraints.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from mpi_tensorflow_tpu.parallel import ring, sharding_rules as rules_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden: int = 768
+    layers: int = 12
+    heads: int = 12
+    mlp: int = 3072
+    max_positions: int = 512
+    dropout: float = 0.1
+    dtype: Any = jnp.float32      # compute dtype; bfloat16 for TPU throughput
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+
+BERT_BASE = BertConfig()
+BERT_TINY = BertConfig(vocab_size=1024, hidden=64, layers=2, heads=4, mlp=128,
+                       max_positions=128, dropout=0.0)
+
+
+def _norm_init(key, shape, stddev=0.02):
+    return jax.random.normal(key, shape) * stddev
+
+
+def _layernorm(x, p, eps=1e-12):
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertMlm:
+    cfg: BertConfig = BERT_BASE
+    mesh: Optional[Any] = None            # when set, activations/attention are
+    rules: Optional[dict] = None          # sharded per the rule table
+
+    # ---------------- init ----------------
+
+    def init(self, rng):
+        c = self.cfg
+        k = iter(jax.random.split(rng, 16 + 16 * c.layers))
+        params = {
+            "tok_emb": _norm_init(next(k), (c.vocab_size, c.hidden)),
+            "pos_emb": _norm_init(next(k), (c.max_positions, c.hidden)),
+            "emb_ln": {"scale": jnp.ones((c.hidden,)),
+                       "bias": jnp.zeros((c.hidden,))},
+            "layers": [],
+            "mlm": {
+                "w": _norm_init(next(k), (c.hidden, c.hidden)),
+                "b": jnp.zeros((c.hidden,)),
+                "ln": {"scale": jnp.ones((c.hidden,)),
+                       "bias": jnp.zeros((c.hidden,))},
+                "out_b": jnp.zeros((c.vocab_size,)),
+            },
+        }
+        for _ in range(c.layers):
+            params["layers"].append({
+                "wq": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
+                "wk": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
+                "wv": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
+                "bq": jnp.zeros((c.heads, c.head_dim)),
+                "bk": jnp.zeros((c.heads, c.head_dim)),
+                "bv": jnp.zeros((c.heads, c.head_dim)),
+                "wo": _norm_init(next(k), (c.heads, c.head_dim, c.hidden)),
+                "bo": jnp.zeros((c.hidden,)),
+                "ln1": {"scale": jnp.ones((c.hidden,)),
+                        "bias": jnp.zeros((c.hidden,))},
+                "w1": _norm_init(next(k), (c.hidden, c.mlp)),
+                "b1": jnp.zeros((c.mlp,)),
+                "w2": _norm_init(next(k), (c.mlp, c.hidden)),
+                "b2": jnp.zeros((c.hidden,)),
+                "ln2": {"scale": jnp.ones((c.hidden,)),
+                        "bias": jnp.zeros((c.hidden,))},
+            })
+        return params
+
+    def logical_axes(self):
+        """Pytree (matching ``init``) of logical axis tuples for the rules."""
+        ln = {"scale": ("embed",), "bias": ("embed",)}
+        layer = {
+            "wq": ("embed", "heads", "head_dim"),
+            "wk": ("embed", "heads", "head_dim"),
+            "wv": ("embed", "heads", "head_dim"),
+            "bq": ("heads", "head_dim"), "bk": ("heads", "head_dim"),
+            "bv": ("heads", "head_dim"),
+            "wo": ("heads", "head_dim", "embed"), "bo": ("embed",),
+            "ln1": ln,
+            "w1": ("embed", "mlp"), "b1": ("mlp",),
+            "w2": ("mlp", "embed"), "b2": ("embed",),
+            "ln2": ln,
+        }
+        return {
+            "tok_emb": ("vocab", "embed"),
+            "pos_emb": ("pos", "embed"),
+            "emb_ln": ln,
+            "layers": [dict(layer) for _ in range(self.cfg.layers)],
+            "mlm": {"w": ("embed", "embed"), "b": ("embed",), "ln": ln,
+                    "out_b": ("vocab",)},
+        }
+
+    # ---------------- forward ----------------
+
+    def _constrain(self, x, axes):
+        if self.mesh is None:
+            return x
+        return rules_lib.constrain(x, axes, self.mesh, self.rules)
+
+    def _attention(self, q, k, v):
+        """q,k,v: (B, H, S, D).  Ring attention over the seq axis when the
+        mesh shards it, dense otherwise."""
+        if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
+            specs = P("data" if self.mesh.shape.get("data", 1) > 1 else None,
+                      "model" if self.mesh.shape.get("model", 1) > 1 else None,
+                      "seq")
+
+            def inner(q, k, v):
+                return ring.ring_attention(q, k, v, "seq")
+
+            return jax.shard_map(inner, mesh=self.mesh,
+                                 in_specs=(specs, specs, specs),
+                                 out_specs=specs)(q, k, v)
+        return ring.dense_attention(q, k, v)
+
+    def apply(self, params, batch, *, train: bool = False, rng=None):
+        """``batch``: int token ids (B, S) (already masked for MLM).
+        Returns vocab logits (B, S, V)."""
+        c = self.cfg
+        dt = c.dtype
+        tokens = batch
+        B, S = tokens.shape
+        drop_i = 0
+
+        def dropout(x):
+            nonlocal drop_i
+            if not train or c.dropout == 0.0:
+                return x
+            if rng is None:
+                raise ValueError("dropout needs an rng in train mode")
+            drop_i += 1
+            keep = 1.0 - c.dropout
+            mask = jax.random.bernoulli(
+                jax.random.fold_in(rng, drop_i), keep, x.shape)
+            return jnp.where(mask, x / keep, 0.0)
+
+        h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
+        h = _layernorm(h, params["emb_ln"])
+        h = dropout(h).astype(dt)
+        h = self._constrain(h, ("batch", "seq", "embed"))
+
+        for lp in params["layers"]:
+            # --- attention (column-parallel QKV, row-parallel out) ---
+            q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
+                + lp["bq"].astype(dt)[None, :, None, :]
+            k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
+                + lp["bk"].astype(dt)[None, :, None, :]
+            v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt)) \
+                + lp["bv"].astype(dt)[None, :, None, :]
+            q = self._constrain(q, ("batch", "heads", "seq", "head_dim"))
+            k = self._constrain(k, ("batch", "heads", "seq", "head_dim"))
+            v = self._constrain(v, ("batch", "heads", "seq", "head_dim"))
+            a = self._attention(q, k, v)
+            a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
+                + lp["bo"].astype(dt)
+            h = _layernorm(h + dropout(a), lp["ln1"]).astype(dt)
+            h = self._constrain(h, ("batch", "seq", "embed"))
+            # --- MLP (column then row parallel) ---
+            m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
+                            + lp["b1"].astype(dt))
+            m = self._constrain(m, ("batch", "seq", "mlp"))
+            m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
+                + lp["b2"].astype(dt)
+            h = _layernorm(h + dropout(m), lp["ln2"]).astype(dt)
+            h = self._constrain(h, ("batch", "seq", "embed"))
+
+        # --- MLM head: transform + tied decoder ---
+        t = jax.nn.gelu(h @ params["mlm"]["w"].astype(dt)
+                        + params["mlm"]["b"].astype(dt))
+        t = _layernorm(t, params["mlm"]["ln"]).astype(dt)
+        logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
+            + params["mlm"]["out_b"]
+        logits = self._constrain(logits, ("batch", "seq", "vocab"))
+        return logits.astype(jnp.float32)
+
+    # ---------------- loss ----------------
+
+    def loss(self, params, model_state, batch, labels, *, rng=None,
+             train: bool = False):
+        """Masked-LM loss: mean CE over masked positions only.
+
+        ``batch``: dict with ``tokens`` (B,S) int32 (mask token substituted)
+        and ``mask`` (B,S) bool; ``labels``: (B,S) int32 original ids.
+        """
+        logits = self.apply(params, batch["tokens"], train=train, rng=rng)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        ce = logz - gold
+        mask = batch["mask"].astype(jnp.float32)
+        loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+        return loss, model_state
+
+    def l2_params(self, params) -> list:
+        return []   # transformer runs use decoupled weight decay (adamw)

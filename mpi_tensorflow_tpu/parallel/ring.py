@@ -1,0 +1,93 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long sequences are sharded along a ``seq`` mesh axis; each shard holds a
+block of queries and a block of keys/values.  K/V blocks rotate around the
+ring via ``ppermute`` (ICI neighbor exchanges) while each shard accumulates
+its queries' attention with the streaming (online) softmax — no shard ever
+materializes the full (S, S) score matrix or the full K/V, so sequence
+length scales with the number of shards at constant per-chip memory.
+
+This subsystem has no counterpart in the reference (no attention, no
+sequence axis — SURVEY.md §2 parallelism checklist); it is required by the
+framework goal of first-class long-context training.
+
+Call ``ring_attention`` inside ``shard_map`` with the ``seq`` axis in scope;
+``dense_attention`` is the single-shard reference implementation (also used
+when the mesh has no seq axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = float("-inf")
+
+
+def dense_attention(q, k, v, *, causal: bool = False,
+                    scale: Optional[float] = None):
+    """Plain softmax attention.  q,k,v: (B, H, S, D)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(ki > qi, NEG_INF, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
+
+
+def ring_attention(q, k, v, axis_name: str = "seq", *,
+                   causal: bool = False, scale: Optional[float] = None):
+    """Blockwise ring attention.  q,k,v: (B, H, S_local, D) per shard.
+
+    Equivalent to ``dense_attention`` on the gathered sequence (validated in
+    tests/test_ring.py); per-shard memory is O(S_local^2) scores instead of
+    O(S^2), and communication is n-1 neighbor ``ppermute`` hops overlapping
+    compute.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    bq = q.shape[2]
+    # the accumulators must carry the same varying-axes type as q/k/v (they
+    # are per-shard values), or the scan carry type check fails; deriving
+    # them from q (rather than lax.pvary) inherits whatever set of mesh axes
+    # q varies over — seq here, plus data/model when nested in a wider mesh
+    zero_q = jnp.sum(q.astype(jnp.float32), axis=-1) * 0.0   # (B, H, Sq)
+    o = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32) \
+        + zero_q[..., None]
+    m = jnp.full(q.shape[:3], NEG_INF, jnp.float32) + zero_q
+    l = zero_q
+
+    def body(carry, i):
+        o, m, l, kb, vb = carry
+        blk = (my - i) % n                                # global idx of kb
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = my * bq + jnp.arange(bq)[:, None]
+            kpos = blk * kb.shape[2] + jnp.arange(kb.shape[2])[None, :]
+            s = jnp.where(kpos > qpos, NEG_INF, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        # all-masked-so-far rows keep m == -inf; normalize against 0 there so
+        # exp() never sees (-inf) - (-inf)
+        m_use = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - m_use[..., None])
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_use))
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
+            preferred_element_type=jnp.float32)
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (o, m_new, l, kb, vb), None
+
+    (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(n))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
