@@ -86,15 +86,20 @@ def main(argv=None) -> int:
 
     meshlib.initialize_distributed()
 
-    from mpi_tensorflow_tpu.train import loop
-
     profiling = args.profile_dir is not None
     if profiling:
         import jax
 
         jax.profiler.start_trace(args.profile_dir)
     try:
-        loop.train(config)
+        if config.model == "bert_base":
+            from mpi_tensorflow_tpu.train import mlm_loop
+
+            mlm_loop.train_mlm(config)
+        else:
+            from mpi_tensorflow_tpu.train import loop
+
+            loop.train(config)
     finally:
         if profiling:
             import jax

@@ -1,0 +1,106 @@
+"""Host loop for the masked-LM family (BASELINE.json config 5).
+
+Same shape as the image loop (train/loop.py) — 50-step trace, timing with
+eval off the timed path — but driven by the GSPMD multi-axis step
+(train/gspmd.py) and the synthetic MLM stream (data/synthetic.py).  The
+printed metric is masked-token prediction error %, the MLM analogue of the
+reference's test-error trace (mpipy.py:88).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import numpy as np
+import optax
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.data import synthetic
+from mpi_tensorflow_tpu.models import bert
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
+from mpi_tensorflow_tpu.train import gspmd
+from mpi_tensorflow_tpu.utils import logging as logs
+from mpi_tensorflow_tpu.utils.timing import StepTimer
+
+
+@dataclasses.dataclass
+class MlmResult:
+    state: Any
+    history: list              # [(step, masked error %)]
+    final_error: float
+    tokens_per_sec: float
+    step_time_seconds: float
+    num_devices: int
+    num_steps: int
+
+
+def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
+              mesh=None, seq_len: int = 128, train_n: int = 4096,
+              test_n: int = 512, learning_rate: float = 1e-4,
+              verbose: bool = True) -> MlmResult:
+    mesh = mesh if mesh is not None else meshlib.make_mesh(config.mesh_shape)
+    ndev = int(np.prod(list(mesh.shape.values())))
+    bert_cfg = bert_cfg or bert.BERT_BASE
+    model = bert.BertMlm(bert_cfg, mesh=mesh)
+    tx = optax.adamw(learning_rate)
+    state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
+                                   mesh)
+    train_step = gspmd.make_gspmd_train_step(model, mesh, tx)
+    eval_step = gspmd.make_gspmd_eval_step(model, mesh)
+
+    tokens, targets, mask = synthetic.mlm_batches(
+        train_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
+        seed=config.seed)
+    ts_tokens, ts_targets, ts_mask = synthetic.mlm_batches(
+        test_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
+        seed=config.seed + 1)
+
+    b = config.batch_size * mesh.shape.get("data", 1)
+    num_steps = config.epochs * (train_n // b)
+    rng = jax.random.key(config.seed + 2)
+    timer = StepTimer(warmup_steps=1)
+    history = []
+    if verbose:
+        logs.session_start(meshlib.process_index())
+
+    def masked_error(s) -> float:
+        errs, tot = 0, 0
+        for i in range(0, min(test_n, 4 * b), b):
+            tok = gspmd.shard_batch(ts_tokens[i:i + b], mesh)
+            logits = np.asarray(eval_step(s, tok))
+            pred = logits.argmax(-1)
+            m = ts_mask[i:i + b]
+            errs += int(((pred != ts_targets[i:i + b]) & m).sum())
+            tot += int(m.sum())
+        return 100.0 * errs / max(tot, 1)
+
+    pending = 0
+    timer.start()
+    for t in range(num_steps):
+        lo = (t * b) % max(train_n - b, 1)
+        batch = gspmd.shard_batch(
+            {"tokens": tokens[lo:lo + b], "mask": mask[lo:lo + b]}, mesh)
+        tgt = gspmd.shard_batch(targets[lo:lo + b], mesh)
+        state, metrics = train_step(state, batch, tgt, rng)
+        pending += 1
+        last = t == num_steps - 1
+        if (t > 0 and t % config.log_every == 0) or last:
+            jax.block_until_ready(state)
+            timer.stop(pending)
+            pending = 0
+            err = masked_error(state)
+            history.append((t, err))
+            if verbose:
+                logs.step_trace(meshlib.process_index(), t, err)
+            timer.start()
+
+    final_err = history[-1][1] if history else float("nan")
+    sec = timer.mean_step_seconds
+    tps = b * seq_len / sec if sec == sec and sec > 0 else float("nan")
+    if verbose:
+        logs.timing_summary(tps, sec * 1e3, ndev)
+    return MlmResult(state=state, history=history, final_error=final_err,
+                     tokens_per_sec=tps, step_time_seconds=sec,
+                     num_devices=ndev, num_steps=num_steps)

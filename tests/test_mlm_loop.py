@@ -1,0 +1,25 @@
+"""MLM loop end-to-end on the 8-device mesh with a tiny BERT."""
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.models import bert
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
+from mpi_tensorflow_tpu.train import mlm_loop
+
+
+class TestMlmLoop:
+    def test_end_to_end_multi_axis(self):
+        mesh = meshlib.make_mesh({"data": 2, "model": 2, "seq": 2})
+        cfg = Config(epochs=8, batch_size=4, log_every=16, seed=1)
+        res = mlm_loop.train_mlm(cfg, bert_cfg=bert.BERT_TINY, mesh=mesh,
+                                 seq_len=32, train_n=128, test_n=64,
+                                 learning_rate=3e-3, verbose=False)
+        assert res.num_devices == 8
+        assert np.isfinite(res.final_error)
+        assert res.tokens_per_sec > 0
+        # held-out masked error must start moving off the 100% plateau
+        # (copy-from-context task; calibrated trajectory reaches ~95% by
+        # step 128 and keeps falling with more steps)
+        assert res.final_error < 97.0, res.history
