@@ -48,6 +48,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dataset", default=d.dataset,
                    choices=["mnist", "cifar10", "imagenet_synthetic",
                             "mlm_synthetic"])
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save train state here at the log cadence")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--mesh", default=None,
                    help="mesh spec, e.g. 'data=8' or 'data=4,model=2'; "
                         "default: all devices on one data axis")
@@ -75,6 +79,7 @@ def config_from_args(args) -> Config:
         sync=args.sync, seed=args.seed, data_dir=args.data_dir,
         model=args.model, dataset=args.dataset,
         mesh_shape=parse_mesh(args.mesh),
+        checkpoint_dir=args.checkpoint_dir, resume=args.resume,
     )
 
 

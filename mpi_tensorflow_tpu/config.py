@@ -51,6 +51,10 @@ class Config:
     mesh_shape: Optional[dict] = None  # e.g. {"data": 8}; None = all devices
                                        # on one "data" axis
 
+    # --- checkpointing (absent from the reference; SURVEY.md §5) ---
+    checkpoint_dir: Optional[str] = None   # None = checkpointing off
+    resume: bool = False                   # resume from latest in the dir
+
     # --- misc ---
     seed: int = 1                 # the reference seeds everything with 1
                                   # (mpipy.py:40, 43, 48, 52, 166)

@@ -1,0 +1,91 @@
+"""Checkpoint / resume.
+
+The reference persists nothing — no ``tf.train.Saver``, any failure loses the
+run (SURVEY.md §5 checkpoint row).  Here any train-state pytree
+(``TrainState`` or ``GspmdState``) round-trips through a numpy ``.npz``
+archive plus a JSON sidecar of metadata; restore takes a template state (from
+``init_state``) so no code objects are ever pickled.  Device placement /
+shardings are re-applied by ``device_put``-ing restored leaves onto the
+template leaves' shardings, so a checkpoint written on one mesh restores
+onto another (e.g. 8-chip run resumed on 16 chips).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+def save(path: str, state: Any, *, step: Optional[int] = None,
+         extra: Optional[dict] = None) -> None:
+    """Write ``state`` (any pytree of arrays) to ``<path>.npz`` (+ ``.json``).
+
+    Multi-host: call on process 0 only (params are replicated or
+    addressable-shard gathers are the caller's policy).
+    """
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i:05d}": np.asarray(x) for i, x in enumerate(leaves)}
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path + ".npz")
+    meta = {"num_leaves": len(leaves), "step": step, "extra": extra or {}}
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
+
+
+def restore(path: str, template: Any) -> tuple[Any, dict]:
+    """Load a checkpoint into the structure (and shardings) of ``template``.
+
+    Returns ``(state, meta)``.  Leaf count/shape mismatches raise — a wrong
+    model/config pairing fails loudly instead of silently reinterpreting.
+    """
+    with open(path + ".json") as f:
+        meta = json.load(f)
+    with np.load(path + ".npz") as z:
+        leaves = [z[f"leaf_{i:05d}"] for i in range(meta["num_leaves"])]
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(t_leaves) != len(leaves):
+        raise ValueError(
+            f"checkpoint has {len(leaves)} leaves, template has "
+            f"{len(t_leaves)} — model/config mismatch")
+    import jax.numpy as jnp
+
+    placed = []
+    for got, want in zip(leaves, t_leaves):
+        if tuple(got.shape) != tuple(want.shape):
+            raise ValueError(
+                f"leaf shape mismatch: checkpoint {got.shape} vs template "
+                f"{want.shape}")
+        got = got.astype(want.dtype)
+        sharding = getattr(want, "sharding", None)
+        if sharding is not None and len(sharding.device_set) > 1:
+            # re-apply the template's mesh placement (sharded training state)
+            placed.append(jax.device_put(got, sharding))
+        else:
+            # leave uncommitted so jit may (re)place it freely — a committed
+            # single-device leaf would conflict with multi-device batches
+            placed.append(jnp.asarray(got))
+    return jax.tree.unflatten(treedef, placed), meta
+
+
+def latest_step(directory: str, prefix: str = "ckpt") -> Optional[int]:
+    """Highest step among ``<prefix>_<step>.npz`` files, or None."""
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith(prefix + "_") and name.endswith(".npz"):
+            try:
+                steps.append(int(name[len(prefix) + 1:-4]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def step_path(directory: str, step: int, prefix: str = "ckpt") -> str:
+    return os.path.join(directory, f"{prefix}_{step}")

@@ -118,6 +118,18 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     else:
         raise ValueError(f"unknown sync mode {config.sync!r}")
 
+    start_step = 0
+    if config.checkpoint_dir and config.resume:
+        from mpi_tensorflow_tpu.train import checkpoint
+
+        last = checkpoint.latest_step(config.checkpoint_dir)
+        if last is not None:
+            state, _ = checkpoint.restore(
+                checkpoint.step_path(config.checkpoint_dir, last), state)
+            start_step = last + 1
+            if verbose:
+                print(f"[checkpoint] resumed from step {last}")
+
     batch_sharding = NamedSharding(mesh, P("data"))
     rng = jax.random.key(config.seed + 1)
     timer = StepTimer(warmup_steps=1)
@@ -131,7 +143,7 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
 
     pending = 0
     timer.start()
-    for t in range(num_steps):
+    for t in range(start_step, num_steps):
         offset = (t * b) % (local_n - b)               # mpipy.py:80
         batch = np.ascontiguousarray(
             tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
@@ -157,6 +169,12 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                     logs.step_trace(r, t, e)
             if config.sync == "avg50" and not last:    # mpipy.py:91
                 state = avg_step(state)
+            if config.checkpoint_dir:
+                from mpi_tensorflow_tpu.train import checkpoint
+
+                checkpoint.save(
+                    checkpoint.step_path(config.checkpoint_dir, t),
+                    state, step=t)
             timer.start()
 
     final_err = history[-1][1] if history else float("nan")
