@@ -1,0 +1,100 @@
+"""ctypes bindings for the native C++ IDX loader (native/idx_loader.cpp).
+
+Fills the native data-path role the reference delegated to external C/C++
+libraries (SURVEY.md §2 E1/E2).  The library is built on demand with the
+in-repo Makefile; every entry point falls back to the pure-Python parser
+(data/idx.py) when the toolchain or build is unavailable, and tests assert
+the two produce identical arrays.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libidxloader.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.exists(_LIB_PATH)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library, building it if needed; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.idx_dims.argtypes = [ctypes.c_char_p, u32p]
+    lib.idx_dims.restype = ctypes.c_int
+    lib.idx_load_images.argtypes = [ctypes.c_char_p, ctypes.c_int, f32p]
+    lib.idx_load_images.restype = ctypes.c_int
+    lib.idx_load_labels.argtypes = [ctypes.c_char_p, ctypes.c_int, i64p]
+    lib.idx_load_labels.restype = ctypes.c_int
+    _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def extract_images(path: str, num_images: int | None = None) -> np.ndarray:
+    """Native-path equivalent of ``data.idx.extract_images`` (bit-identical
+    output); falls back to the Python parser when the library is missing."""
+    lib = get_lib()
+    if lib is None:
+        from mpi_tensorflow_tpu.data import idx
+
+        return idx.extract_images(path, num_images)
+    dims = np.zeros(4, np.uint32)
+    nd = lib.idx_dims(path.encode(), dims)
+    if nd != 3:
+        raise ValueError(f"{path}: native loader error/ndim {nd}")
+    n = int(dims[0]) if num_images is None else min(int(dims[0]), num_images)
+    out = np.empty((n, int(dims[1]), int(dims[2]), 1), np.float32)
+    rows = lib.idx_load_images(path.encode(), n, out.reshape(-1))
+    if rows != n:
+        raise ValueError(f"{path}: native image load failed ({rows})")
+    return out
+
+
+def extract_labels(path: str, num_labels: int | None = None) -> np.ndarray:
+    lib = get_lib()
+    if lib is None:
+        from mpi_tensorflow_tpu.data import idx
+
+        return idx.extract_labels(path, num_labels)
+    dims = np.zeros(4, np.uint32)
+    nd = lib.idx_dims(path.encode(), dims)
+    if nd != 1:
+        raise ValueError(f"{path}: native loader error/ndim {nd}")
+    n = int(dims[0]) if num_labels is None else min(int(dims[0]), num_labels)
+    out = np.empty((n,), np.int64)
+    rows = lib.idx_load_labels(path.encode(), n, out)
+    if rows != n:
+        raise ValueError(f"{path}: native label load failed ({rows})")
+    return out

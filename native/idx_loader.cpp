@@ -1,0 +1,122 @@
+// Native IDX data loader.
+//
+// The reference leans on external native libraries for its runtime (OpenMPI
+// in C, the TF executor in C++ — SURVEY.md §2 E1/E2); this module fills the
+// native data-path role for the new framework: gzip inflation, IDX parsing,
+// pixel normalization and label widening run in C++ at memcpy-like speed,
+// exposed to Python through a minimal C ABI consumed via ctypes
+// (mpi_tensorflow_tpu/data/native.py).  The Python parser in data/idx.py
+// remains the reference implementation and the fallback when this library
+// is not built; tests assert bit-identical outputs.
+//
+// Build: `make -C native` (g++ -O3 -shared -fPIC idx_loader.cpp -lz).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <zlib.h>
+
+namespace {
+
+// Inflate a (possibly gzip'd) file fully into `out`. Returns 0 on success.
+int read_all(const char* path, std::vector<uint8_t>& out) {
+  gzFile f = gzopen(path, "rb");  // transparently handles uncompressed too
+  if (!f) return -1;
+  out.clear();
+  uint8_t chunk[1 << 16];
+  int n;
+  while ((n = gzread(f, chunk, sizeof(chunk))) > 0) {
+    out.insert(out.end(), chunk, chunk + n);
+  }
+  int err = 0;
+  gzerror(f, &err);
+  gzclose(f);
+  return (n < 0 || err != Z_OK) ? -2 : 0;
+}
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+}
+
+// Parse header: magic 00 00 <dtype> <ndim>, then ndim big-endian u32 dims.
+// Only dtype 0x08 (u8) is needed for MNIST-family files.
+int parse_header(const std::vector<uint8_t>& buf, uint32_t* dims, int* ndim,
+                 size_t* payload_off) {
+  if (buf.size() < 4 || buf[0] != 0 || buf[1] != 0) return -3;
+  if (buf[2] != 0x08) return -4;  // not uint8
+  int nd = buf[3];
+  if (nd < 1 || nd > 4 || buf.size() < size_t(4 + 4 * nd)) return -5;
+  size_t count = 1;
+  for (int i = 0; i < nd; ++i) {
+    dims[i] = be32(buf.data() + 4 + 4 * i);
+    count *= dims[i];
+  }
+  if (buf.size() < 4 + 4 * size_t(nd) + count) return -6;
+  *ndim = nd;
+  *payload_off = 4 + 4 * size_t(nd);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Query the dims of an IDX file: fills dims[0..3], returns ndim (<0 = error).
+int idx_dims(const char* path, uint32_t* dims) {
+  std::vector<uint8_t> buf;
+  if (int rc = read_all(path, buf)) return rc;
+  int nd;
+  size_t off;
+  if (int rc = parse_header(buf, dims, &nd, &off)) return rc;
+  return nd;
+}
+
+// Images: u8 (N,H,W) -> float32 (N,H,W,1) normalized (p - 127.5)/255,
+// matching data/idx.py extract_images (and the buffers at mpipy.py:230).
+// `out` must hold max_items*H*W floats. Returns rows written (<0 = error).
+int idx_load_images(const char* path, int max_items, float* out) {
+  std::vector<uint8_t> buf;
+  if (int rc = read_all(path, buf)) return rc;
+  uint32_t dims[4];
+  int nd;
+  size_t off;
+  if (int rc = parse_header(buf, dims, &nd, &off)) return rc;
+  if (nd != 3) return -7;
+  size_t n = dims[0];
+  if (max_items >= 0 && size_t(max_items) < n) n = size_t(max_items);
+  size_t count = n * dims[1] * dims[2];
+  const uint8_t* src = buf.data() + off;
+  for (size_t i = 0; i < count; ++i) {
+    out[i] = (float(src[i]) - 127.5f) / 255.0f;
+  }
+  return int(n);
+}
+
+// Labels: u8 (N,) -> int64 (N,), matching extract_labels.
+int idx_load_labels(const char* path, int max_items, int64_t* out) {
+  std::vector<uint8_t> buf;
+  if (int rc = read_all(path, buf)) return rc;
+  uint32_t dims[4];
+  int nd;
+  size_t off;
+  if (int rc = parse_header(buf, dims, &nd, &off)) return rc;
+  if (nd != 1) return -7;
+  size_t n = dims[0];
+  if (max_items >= 0 && size_t(max_items) < n) n = size_t(max_items);
+  const uint8_t* src = buf.data() + off;
+  for (size_t i = 0; i < n; ++i) out[i] = int64_t(src[i]);
+  return int(n);
+}
+
+// Contiguous shard copy: rows [start, start+rows) of a float32 (N, row_elems)
+// matrix into out — the C++ fast path for per-host shard slicing.
+void shard_copy_f32(const float* src, int64_t row_elems, int64_t start,
+                    int64_t rows, float* out) {
+  memcpy(out, src + start * row_elems,
+         size_t(rows) * size_t(row_elems) * sizeof(float));
+}
+
+}  // extern "C"
