@@ -119,10 +119,14 @@ def load_splits(data_dir: str = "./data", num_shards: int = 1,
     tr_total = sharding.truncate_to_multiple(avail_train * 55000 // _TRAIN_N, k)
     ts_total = sharding.truncate_to_multiple(avail_test, k)
 
-    tr_data = idx.extract_images(paths["train_images"], avail_train)
-    tr_labels = idx.extract_labels(paths["train_labels"], avail_train)
-    ts_data = idx.extract_images(paths["test_images"], ts_total)
-    ts_labels = idx.extract_labels(paths["test_labels"], ts_total)
+    # native C++ loader when built (bit-identical; data/native.py falls back
+    # to the Python parser itself when the library is unavailable)
+    from mpi_tensorflow_tpu.data import native
+
+    tr_data = native.extract_images(paths["train_images"], avail_train)
+    tr_labels = native.extract_labels(paths["train_labels"], avail_train)
+    ts_data = native.extract_images(paths["test_images"], ts_total)
+    ts_labels = native.extract_labels(paths["test_labels"], ts_total)
 
     return Splits(
         train_data=tr_data[val_total:tr_total],
