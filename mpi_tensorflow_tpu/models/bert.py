@@ -69,6 +69,7 @@ class BertMlm:
     cfg: BertConfig = BERT_BASE
     mesh: Optional[Any] = None            # when set, activations/attention are
     rules: Optional[dict] = None          # sharded per the rule table
+    use_flash: bool = True                # Pallas flash kernel on TPU
 
     # ---------------- init ----------------
 
@@ -143,7 +144,8 @@ class BertMlm:
 
     def _attention(self, q, k, v):
         """q,k,v: (B, H, S, D).  Ring attention over the seq axis when the
-        mesh shards it, dense otherwise."""
+        mesh shards it; otherwise the Pallas flash kernel on TPU (falls back
+        to dense when shapes/platform don't allow it)."""
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             specs = P("data" if self.mesh.shape.get("data", 1) > 1 else None,
                       "model" if self.mesh.shape.get("model", 1) > 1 else None,
@@ -155,6 +157,11 @@ class BertMlm:
             return jax.shard_map(inner, mesh=self.mesh,
                                  in_specs=(specs, specs, specs),
                                  out_specs=specs)(q, k, v)
+        if self.use_flash and q.shape[2] % 128 == 0 \
+                and jax.devices()[0].platform == "tpu":
+            from mpi_tensorflow_tpu.ops import flash_attention as fa
+
+            return fa.flash_attention(q, k, v)
         return ring.dense_attention(q, k, v)
 
     def apply(self, params, batch, *, train: bool = False, rng=None):

@@ -1,0 +1,203 @@
+"""Flash attention — Pallas TPU kernel for the transformer hot op.
+
+The reference delegates its hot ops to the TF runtime's fused C++ kernels
+(SURVEY.md §2 E2); here the attention inner loop is a hand-written Pallas
+kernel: Q/K/V stream HBM->VMEM in blocks, scores and the online softmax stay
+in VMEM scratch, and the (S, S) score matrix is never materialized in HBM —
+O(S) memory instead of O(S^2), with the two matmuls on the MXU.
+
+Three layers, all numerically equivalent (tests assert so):
+- ``flash_attention``     public entry: Pallas forward + custom-VJP backward
+                          (backward recomputes via the blockwise JAX path —
+                          standard flash recomputation strategy);
+- ``blockwise_attention`` pure-JAX online-softmax scan: memory-efficient,
+                          differentiable, runs anywhere (CPU fallback and
+                          the backward's recompute);
+- ``dense_attention``     reference implementation (parallel/ring.py).
+
+Grid layout: ``(batch*heads, q_blocks, kv_blocks)`` — the kv dimension is
+innermost and TPU grids execute sequentially per core, so the VMEM scratch
+accumulators persist across kv steps (init at kv==0, emit at the last block).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_BIG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# pure-JAX blockwise online softmax (fallback + backward recompute)
+# ---------------------------------------------------------------------------
+
+def blockwise_attention(q, k, v, *, causal: bool = False,
+                        scale: Optional[float] = None, block_k: int = 128):
+    """O(S * block_k) memory attention via lax.scan.  q,k,v: (B, H, S, D)."""
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    S = k.shape[2]
+    block_k = min(block_k, S)
+    nk = -(-S // block_k)
+    pad = nk * block_k - S
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    kb = kp.reshape(*k.shape[:2], nk, block_k, k.shape[-1])
+    vb = vp.reshape(*v.shape[:2], nk, block_k, v.shape[-1])
+    qpos = jnp.arange(q.shape[2])[:, None]
+
+    def body(carry, blk):
+        o, m, l = carry
+        kblk, vblk, i = blk
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, kblk,
+                       preferred_element_type=jnp.float32) * scale
+        kpos = i * block_k + jnp.arange(block_k)[None, :]
+        invalid = kpos >= S
+        if causal:
+            invalid = invalid | (kpos > qpos)
+        s = jnp.where(invalid, NEG_BIG, s)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p.astype(vblk.dtype), vblk,
+            preferred_element_type=jnp.float32)
+        return (o, m_new, l), None
+
+    o0 = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    m0 = jnp.full(q.shape[:3], NEG_BIG, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    kb_t = jnp.moveaxis(kb, 2, 0)
+    vb_t = jnp.moveaxis(vb, 2, 0)
+    (o, m, l), _ = lax.scan(body, (o0, m0, l0),
+                            (kb_t, vb_t, jnp.arange(nk)))
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (o / l[..., None]).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas forward kernel
+# ---------------------------------------------------------------------------
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc, m_scr, l_scr, *,
+                  scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+    last_k = nk - 1
+    if causal:
+        # last kv block this q block needs (blocks past the diagonal skip)
+        last_k = jnp.minimum(((qi + 1) * block_q - 1) // block_k, nk - 1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(ki <= last_k)
+    def _step():
+        q = q_ref[0]                                   # (BQ, D)
+        k = k_ref[0]                                   # (BK, D)
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (BQ, BK)
+        if causal:
+            qpos = qi * block_q + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(kpos > qpos, NEG_BIG, s)
+        m_prev = m_scr[:, 0:1]                         # (BQ, 1)
+        l_prev = l_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # (BQ, BK)
+        corr = jnp.exp(m_prev - m_new)                 # (BQ, 1)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == last_k)
+    def _emit():
+        l = l_scr[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / l).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
+                   block_k: int, interpret: bool):
+    B, H, S, D = q.shape
+    Dv = v.shape[-1]
+    assert S % block_q == 0 and S % block_k == 0, (
+        f"seq len {S} must be divisible by block sizes ({block_q},{block_k})")
+    qf = q.reshape(B * H, S, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, Dv)
+    grid = (B * H, S // block_q, S // block_k)
+
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((B * H, S, Dv), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, Dv), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, Dv), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, Dv), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(B, H, S, Dv)
+
+
+# ---------------------------------------------------------------------------
+# public entry with custom VJP (flash forward, blockwise-recompute backward)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    return _flash_forward(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, scale, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: blockwise_attention(q, k, v, causal=causal,
+                                            scale=scale, block_k=block_k),
+        q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
