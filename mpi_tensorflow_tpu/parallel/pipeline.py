@@ -1,0 +1,102 @@
+"""Pipeline parallelism (PP): GPipe-style microbatched stage pipeline.
+
+The layer stack is split into P stages whose parameters live sharded over a
+``pipe`` mesh axis (one stage per shard).  A batch is cut into M microbatches
+that flow stage-to-stage through ``ppermute`` neighbor hops: at tick t, stage
+s processes microbatch t-s while its neighbors work on adjacent microbatches
+— the classic pipeline schedule with (P-1) bubble ticks around M useful ones.
+The whole schedule is a ``lax.scan``, so reverse-mode autodiff derives the
+backward pipeline automatically (the transpose of ``ppermute`` is the
+reverse hop).
+
+No counterpart in the reference (SURVEY.md §2 checklist: PP absent); part of
+the full parallelism-strategy coverage.  Use ``pipeline`` inside
+``shard_map`` with the ``pipe`` axis in scope — see ``make_pipelined_fn`` for
+the jit-ready wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def pipeline(stage_fn: Callable, stage_params: Any, microbatches,
+             axis: str = "pipe"):
+    """Run ``stage_fn(params, x) -> y`` as a P-stage pipeline.
+
+    Inside ``shard_map``: ``stage_params`` is this shard's stage parameters,
+    ``microbatches`` has shape (M, mb, ...) and must hold the SAME full set
+    of microbatches on every shard (replicated over ``axis``); the result is
+    the final stage's outputs, (M, mb, ...), valid on every shard.
+    """
+    n_stages = lax.axis_size(axis)
+    stage_idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    ticks = m + n_stages - 1
+    out_dtype = microbatches.dtype
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def tick_fn(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (if any); other stages use the
+        # activation handed to them by the previous stage last tick
+        feed_idx = jnp.clip(t, 0, m - 1)
+        fed = jnp.where(stage_idx == 0,
+                        microbatches[feed_idx].astype(state.dtype), state)
+        y = stage_fn(stage_params, fed)
+        # last stage emits microbatch t-(P-1) when it is valid
+        out_idx = t - (n_stages - 1)
+        valid = (stage_idx == n_stages - 1) & (out_idx >= 0)
+        outputs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y.astype(out_dtype), jnp.clip(out_idx, 0, m - 1), 0),
+            lambda o: o,
+            outputs)
+        # hand activations to the next stage
+        state = lax.ppermute(y, axis, perm_fwd)
+        return (state, outputs), None
+
+    state0 = jnp.zeros(microbatches.shape[1:], microbatches.dtype)
+    state0 = state0 + jnp.sum(microbatches[:1]) * 0   # inherit varying axes
+    outputs0 = jnp.zeros_like(microbatches)
+    (_, outputs), _ = lax.scan(tick_fn, (state0, outputs0),
+                               jnp.arange(ticks))
+    # every shard returns the outputs; only the last stage's copy is real —
+    # broadcast it so the result is replicated over the pipe axis
+    src = n_stages - 1
+    outputs = lax.psum(
+        jnp.where(stage_idx == src, outputs, jnp.zeros_like(outputs)), axis)
+    return outputs
+
+
+def make_pipelined_fn(stage_fn: Callable, mesh: Mesh,
+                      num_microbatches: int, axis: str = "pipe"):
+    """jit-ready wrapper: ``f(stacked_params, batch) -> out``.
+
+    ``stacked_params``: pytree with a leading stage dimension (length = pipe
+    axis size), placed sharded over ``axis``.  ``batch``: (N, ...) global
+    batch, replicated; it is cut into ``num_microbatches`` equal slices.
+    """
+    def fn(stacked_params, batch):
+        def inner(stacked_params, batch):
+            params = jax.tree.map(lambda x: x[0], stacked_params)
+            mb = batch.reshape((num_microbatches,
+                                batch.shape[0] // num_microbatches)
+                               + batch.shape[1:])
+            out = pipeline(stage_fn, params, mb, axis)
+            return out.reshape(batch.shape[0], *out.shape[2:])
+
+        return jax.shard_map(
+            inner, mesh=mesh,
+            in_specs=(P(axis), P()),
+            out_specs=P(),
+            check_vma=False,
+        )(stacked_params, batch)
+
+    return fn

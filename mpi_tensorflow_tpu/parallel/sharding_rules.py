@@ -30,6 +30,9 @@ DEFAULT_RULES: dict[str, Optional[str]] = {
     "vocab": "model",    # embedding/LM-head vocab-parallel
     "pos": None,
     "classes": None,
+    "expert": "expert",  # MoE expert stacks expert-parallel (models/moe.py)
+    "expert_classes": None,   # router output dim (small) replicated
+    "stage": "pipe",     # pipeline-stage stacks (parallel/pipeline.py)
 }
 
 

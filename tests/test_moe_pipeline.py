@@ -1,0 +1,115 @@
+"""EP (MoE) and PP (pipeline) tests — completing the parallelism checklist."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_tensorflow_tpu.data import synthetic
+from mpi_tensorflow_tpu.models import bert, moe
+from mpi_tensorflow_tpu.parallel import mesh as meshlib, pipeline, \
+    sharding_rules
+from mpi_tensorflow_tpu.train import gspmd
+
+
+class TestMoe:
+    @pytest.fixture(scope="class")
+    def mesh_exp(self):
+        return meshlib.make_mesh({"data": 2, "expert": 2, "seq": 2})
+
+    def test_expert_params_sharded(self, mesh_exp):
+        model = moe.MoeBertMlm(bert.BERT_TINY, mesh=mesh_exp,
+                               moe=moe.MoeConfig(num_experts=4))
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh_exp)
+        lp = state.params["layers"][1]          # odd layers are MoE
+        assert "ew1" in lp and "w1" not in lp
+        assert lp["ew1"].sharding.spec == P("expert",)
+        assert "w1" in state.params["layers"][0]  # even layers stay dense
+
+    def test_full_step_dp_ep_sp(self, mesh_exp):
+        """Train step with batch over data, experts over expert, seq over
+        seq — EP joins the covered strategy set."""
+        model = moe.MoeBertMlm(bert.BERT_TINY, mesh=mesh_exp,
+                               moe=moe.MoeConfig(num_experts=4))
+        tx = optax.adamw(2e-3)
+        state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh_exp)
+        step = gspmd.make_gspmd_train_step(model, mesh_exp, tx)
+        tokens, targets, mask = synthetic.mlm_batches(
+            4, seq_len=32, vocab_size=bert.BERT_TINY.vocab_size)
+        batch = gspmd.shard_batch({"tokens": tokens, "mask": mask}, mesh_exp)
+        tgt = gspmd.shard_batch(targets, mesh_exp)
+        losses = []
+        for _ in range(8):
+            state, m = step(state, batch, tgt, jax.random.key(1))
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0] - 0.5, losses
+
+    def test_routing_is_selective(self):
+        """Different tokens must reach different experts (not all one)."""
+        model = moe.MoeBertMlm(bert.BERT_TINY,
+                               moe=moe.MoeConfig(num_experts=4))
+        params = model.init(jax.random.key(0))
+        h = jnp.array(np.random.default_rng(0).normal(
+            size=(2, 16, bert.BERT_TINY.hidden)).astype(np.float32))
+        gate_logits = jnp.einsum(
+            "bse,ec->bsc", h, params["layers"][1]["router"])
+        top1 = np.asarray(jnp.argmax(gate_logits, -1))
+        assert len(np.unique(top1)) > 1
+
+
+class TestPipeline:
+    @pytest.fixture(scope="class")
+    def mesh_pipe(self):
+        return meshlib.make_mesh({"pipe": 4, "data": 2})
+
+    def test_pipeline_matches_sequential(self, mesh_pipe):
+        """4-stage pipelined MLP == running the 4 stages sequentially."""
+        rng = np.random.default_rng(0)
+        d = 16
+        stacked_w = jnp.array(rng.normal(size=(4, d, d)).astype(np.float32) * 0.3)
+        sharded_w = jax.device_put(
+            stacked_w, NamedSharding(mesh_pipe, P("pipe")))
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        batch = jnp.array(rng.normal(size=(8, d)).astype(np.float32))
+        f = jax.jit(pipeline.make_pipelined_fn(stage_fn, mesh_pipe,
+                                               num_microbatches=4))
+        got = np.asarray(f(sharded_w, batch))
+
+        want = np.asarray(batch)
+        for s in range(4):
+            want = np.tanh(want @ np.asarray(stacked_w[s]))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_pipeline_differentiable(self, mesh_pipe):
+        """Backward pipeline comes from autodiff through the schedule."""
+        rng = np.random.default_rng(1)
+        d = 8
+        stacked_w = jnp.array(rng.normal(size=(4, d, d)).astype(np.float32) * 0.3)
+        sharded_w = jax.device_put(
+            stacked_w, NamedSharding(mesh_pipe, P("pipe")))
+        batch = jnp.array(rng.normal(size=(8, d)).astype(np.float32))
+
+        def stage_fn(w, x):
+            return jnp.tanh(x @ w)
+
+        f = pipeline.make_pipelined_fn(stage_fn, mesh_pipe, 4)
+
+        def loss_pipe(w):
+            return jnp.sum(f(w, batch) ** 2)
+
+        def loss_seq(w):
+            x = batch
+            for s in range(4):
+                x = jnp.tanh(x @ w[s])
+            return jnp.sum(x ** 2)
+
+        g_pipe = np.asarray(jax.jit(jax.grad(loss_pipe))(sharded_w))
+        g_seq = np.asarray(jax.grad(loss_seq)(stacked_w))
+        np.testing.assert_allclose(g_pipe, g_seq, rtol=1e-4, atol=1e-5)
