@@ -25,6 +25,8 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from mpi_tensorflow_tpu.models import base
+from mpi_tensorflow_tpu.parallel import fsdp as fsdp_lib
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
 from mpi_tensorflow_tpu.parallel import sharding_rules as rules_lib
 
 
@@ -47,6 +49,38 @@ def init_gspmd_state(model, tx: optax.GradientTransformation, rng,
     return GspmdState(params, opt, mstate, jnp.zeros((), jnp.int32))
 
 
+def _place_replicated(tree: Any, mesh: Mesh) -> Any:
+    """Pin any leaf without an explicit mesh placement to full replication
+    (optimizer step counters, model state, the step scalar)."""
+    rep = meshlib.replicated(mesh)
+
+    def place(x):
+        if isinstance(getattr(x, "sharding", None), NamedSharding):
+            return x
+        return jax.device_put(jnp.asarray(x), rep)
+
+    return jax.tree.map(place, tree)
+
+
+def init_fsdp_state(model, tx: optax.GradientTransformation, rng,
+                    mesh: Mesh, rules: Optional[dict] = None,
+                    axis: str = "data",
+                    min_size: int = fsdp_lib.DEFAULT_MIN_SIZE) -> GspmdState:
+    """ZeRO/FSDP initialization: parameters — and therefore the optimizer
+    moments created from them — live sharded along ``axis``.  TP axes from
+    the model's logical rules are kept; FSDP claims a second dimension
+    (parallel/fsdp.py)."""
+    params = model.init(rng)
+    logical = model.logical_axes() if hasattr(model, "logical_axes") else None
+    specs = fsdp_lib.fsdp_tree_specs(params, mesh, logical, rules,
+                                     axis=axis, min_size=min_size)
+    params = fsdp_lib.shard_params(params, mesh, specs)
+    opt = _place_replicated(tx.init(params), mesh)
+    mstate = _place_replicated(base.init_model_state(model), mesh)
+    step = jax.device_put(jnp.zeros((), jnp.int32), meshlib.replicated(mesh))
+    return GspmdState(params, opt, mstate, step)
+
+
 def shard_batch(tree: Any, mesh: Mesh):
     """Place host batch arrays: leading dim over ``data``, second dim over
     ``seq`` when the mesh has one (token grids are (B, S))."""
@@ -63,11 +97,17 @@ def shard_batch(tree: Any, mesh: Mesh):
 
 
 def make_gspmd_train_step(model, mesh: Mesh,
-                          tx: optax.GradientTransformation):
+                          tx: optax.GradientTransformation,
+                          state_template: Optional[GspmdState] = None):
     """Full training step: loss -> grads -> optax update, all under one jit.
 
     ``model.loss(params, model_state, batch, labels, rng=..., train=True)``
     supplies the objective (the MLM loss for BERT).
+
+    ``state_template`` (an initialized, placed state) pins the output state
+    back to its input shardings — required for FSDP, where the compiler
+    must re-scatter parameters and moments after the update instead of
+    leaving them gathered.
     """
 
     def step(state: GspmdState, batch, labels, rng):
@@ -84,7 +124,11 @@ def make_gspmd_train_step(model, mesh: Mesh,
         return (GspmdState(params, opt, ms, state.step + 1),
                 {"loss": loss})
 
-    return jax.jit(step, donate_argnums=0)
+    if state_template is None:
+        return jax.jit(step, donate_argnums=0)
+    out_shardings = (fsdp_lib.state_out_shardings(state_template),
+                     {"loss": meshlib.replicated(mesh)})
+    return jax.jit(step, donate_argnums=0, out_shardings=out_shardings)
 
 
 def make_gspmd_eval_step(model, mesh: Mesh):

@@ -1,0 +1,144 @@
+"""ZeRO/FSDP sharding tests on the 8-device virtual CPU mesh.
+
+The reference replicates every parameter on every rank and keeps optimizer
+state per-rank, never communicated (mpipy.py:38-53, 65-66).  These tests
+verify the TPU-native FSDP layer: parameters and moments stored sharded,
+training numerically equivalent to replicated data parallelism, and
+composition with Megatron TP.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from mpi_tensorflow_tpu.data import synthetic
+from mpi_tensorflow_tpu.models import bert
+from mpi_tensorflow_tpu.parallel import fsdp, mesh as meshlib
+from mpi_tensorflow_tpu.train import gspmd
+
+TINY = bert.BertConfig(vocab_size=128, hidden=32, layers=2, heads=4,
+                       mlp=64, max_positions=32, dropout=0.0)
+
+
+def _axes(sharding) -> set:
+    """Mesh axes used by a NamedSharding's spec."""
+    out = set()
+    for e in sharding.spec:
+        if e is None:
+            continue
+        out.update(e if isinstance(e, tuple) else (e,))
+    return out
+
+
+class TestAugmentSpec:
+    def test_shards_largest_divisible_dim(self, mesh8):
+        spec = fsdp.augment_spec(P(), (3136, 512), mesh8)
+        assert spec == P("data")
+
+    def test_small_tensor_stays_replicated(self, mesh8):
+        assert fsdp.augment_spec(P(), (32,), mesh8) == P()
+
+    def test_indivisible_dims_stay_replicated(self, mesh8):
+        assert fsdp.augment_spec(P(), (7, 9, 100), mesh8, min_size=1) == P()
+
+    def test_preserves_existing_axis(self):
+        mesh = meshlib.make_mesh({"data": 4, "model": 2})
+        spec = fsdp.augment_spec(P(None, "model"), (256, 128), mesh)
+        assert spec == P("data", "model")
+
+    def test_no_double_claim(self):
+        mesh = meshlib.make_mesh({"data": 8})
+        spec = fsdp.augment_spec(P("data"), (256, 128), mesh)
+        assert spec == P("data")
+
+
+def _batch(mesh, n=16, seq=16):
+    tokens, targets, mask = synthetic.mlm_batches(
+        n, seq_len=seq, vocab_size=TINY.vocab_size)
+    batch = gspmd.shard_batch({"tokens": tokens, "mask": mask}, mesh)
+    targets = gspmd.shard_batch(targets, mesh)
+    return batch, targets
+
+
+@pytest.fixture(scope="module")
+def dp8():
+    """8-way data mesh in GSPMD (auto) mode — the framework's own mesh
+    constructor, matching what the CLI builds."""
+    return meshlib.make_mesh({"data": 8})
+
+
+class TestFsdpTraining:
+    def test_params_and_moments_are_sharded(self, dp8):
+        model = bert.BertMlm(TINY, mesh=dp8)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_fsdp_state(model, tx, jax.random.key(0), dp8,
+                                      min_size=512)
+        sharded = [x for x in jax.tree.leaves(state.params)
+                   if x.size >= 512 and "data" in _axes(x.sharding)]
+        assert sharded, "no parameter picked up the data axis"
+        for x in sharded:
+            assert x.addressable_shards[0].data.size == x.size // 8
+        # adam moments inherit the param placement
+        mu = jax.tree.leaves(state.opt)
+        big = [m for m in mu if hasattr(m, "sharding") and m.size >= 512
+               and m.ndim >= 1]
+        assert any(m.addressable_shards[0].data.size == m.size // 8
+                   for m in big)
+
+    def test_fsdp_matches_replicated_dp(self, dp8):
+        """FSDP is a memory layout, not an algorithm: losses must match the
+        replicated data-parallel GSPMD step."""
+        tx = optax.adamw(1e-3)
+        model = bert.BertMlm(TINY, mesh=dp8)
+
+        ref_state = gspmd.init_gspmd_state(model, tx, jax.random.key(0),
+                                           dp8)
+        ref_step = gspmd.make_gspmd_train_step(model, dp8, tx)
+
+        fs_state = gspmd.init_fsdp_state(model, tx, jax.random.key(0), dp8,
+                                         min_size=512)
+        fs_step = gspmd.make_gspmd_train_step(model, dp8, tx,
+                                              state_template=fs_state)
+
+        batch, targets = _batch(dp8)
+        for i in range(3):
+            rng = jax.random.key(100 + i)
+            ref_state, mref = ref_step(ref_state, batch, targets, rng)
+            fs_state, mfs = fs_step(fs_state, batch, targets, rng)
+            np.testing.assert_allclose(float(mref["loss"]),
+                                       float(mfs["loss"]), rtol=2e-5)
+
+    def test_update_keeps_fsdp_placement(self, dp8):
+        """After a step, parameters must still be sharded (the compiler must
+        not leave them gathered)."""
+        model = bert.BertMlm(TINY, mesh=dp8)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_fsdp_state(model, tx, jax.random.key(0), dp8,
+                                      min_size=512)
+        step = gspmd.make_gspmd_train_step(model, dp8, tx,
+                                           state_template=state)
+        batch, targets = _batch(dp8)
+        before = jax.tree.map(lambda x: x.sharding, state)
+        state, _ = step(state, batch, targets, jax.random.key(1))
+        after = jax.tree.map(lambda x: x.sharding, state)
+        assert jax.tree.all(jax.tree.map(lambda a, b: a == b, before, after))
+
+    def test_fsdp_composes_with_tp(self):
+        """2-D layout: model axis from the logical rules + data axis from
+        FSDP on the same weight."""
+        mesh = meshlib.make_mesh({"data": 4, "model": 2})
+        model = bert.BertMlm(TINY, mesh=mesh)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_fsdp_state(model, tx, jax.random.key(0), mesh,
+                                      min_size=512)
+        both = [x for x in jax.tree.leaves(state.params)
+                if {"data", "model"} <= _axes(x.sharding)]
+        assert both, "no weight carries both TP and FSDP axes"
+        step = gspmd.make_gspmd_train_step(model, mesh, tx,
+                                           state_template=state)
+        batch, targets = _batch(mesh)
+        state, metrics = step(state, batch, targets, jax.random.key(1))
+        assert np.isfinite(float(metrics["loss"]))
