@@ -69,6 +69,49 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5) -> dict:
     }
 
 
+def measure_allreduce(payload_mb: float = 25.4, iters: int = 50) -> dict:
+    """Gradient-allreduce step time — the second half of the north-star
+    metric ('allreduce step-time vs MPI baseline', BASELINE.json).
+
+    Times an in-graph ``psum`` over the data axis on a payload shaped like
+    the model gradient pytree.  The default payload is the MNIST CNN's
+    1.66M-param gradient (6.65 MB) scaled to the BERT-comparable 25.4 MB
+    unless overridden.  The MPI analogue is the reference's per-sync
+    ``Gather`` of the four weight tensors (mpipy.py:121-127) — which is not
+    even an allreduce; we time the honest collective.
+    """
+    import jax
+    import numpy as np
+
+    from mpi_tensorflow_tpu.parallel import mesh as meshlib
+    from mpi_tensorflow_tpu.utils.timing import time_fn
+
+    mesh = meshlib.make_mesh()
+    n = meshlib.data_axis_size(mesh)
+    nfloats = int(payload_mb * 1e6 / 4)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    x = jax.device_put(np.random.default_rng(0)
+                       .normal(size=(n, nfloats)).astype(np.float32) * 1e-3,
+                       NamedSharding(mesh, P("data")))
+
+    @jax.jit
+    def allreduce(v):
+        return jax.shard_map(
+            lambda s: jax.lax.psum(s, "data"), mesh=mesh,
+            in_specs=P("data"), out_specs=P(None),
+            check_vma=False)(v)
+
+    sec = time_fn(allreduce, x, iters=iters, warmup=5)
+    return {
+        "allreduce_ms": sec * 1e3,
+        "payload_mb": payload_mb,
+        "algbw_gbps": (payload_mb / 1e3) / sec if sec > 0 else float("inf"),
+        "num_devices": n,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--record-baseline", action="store_true",
@@ -76,7 +119,20 @@ def main(argv=None) -> int:
                          "(reference-semantics single-process measurement)")
     ap.add_argument("--steps", type=int, default=100)
     ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--mode", choices=["train", "allreduce"], default="train")
+    ap.add_argument("--payload-mb", type=float, default=25.4)
     args = ap.parse_args(argv)
+
+    if args.mode == "allreduce":
+        r = measure_allreduce(payload_mb=args.payload_mb, iters=args.steps)
+        print(json.dumps({
+            "metric": "gradient allreduce step time",
+            "value": round(r["allreduce_ms"], 3),
+            "unit": "ms",
+            "vs_baseline": None,
+            "detail": r,
+        }))
+        return 0
 
     result = measure(batch_size=args.batch_size, steps=args.steps)
 
