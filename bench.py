@@ -95,10 +95,12 @@ def measure_allreduce(payload_mb: float = 25.4, iters: int = 50) -> dict:
                        .normal(size=(n, nfloats)).astype(np.float32) * 1e-3,
                        NamedSharding(mesh, P("data")))
 
+    from mpi_tensorflow_tpu.parallel import collectives
+
     @jax.jit
     def allreduce(v):
         return jax.shard_map(
-            lambda s: jax.lax.psum(s, "data"), mesh=mesh,
+            lambda s: collectives.allreduce_sum(s, axis="data"), mesh=mesh,
             in_specs=P("data"), out_specs=P(None),
             check_vma=False)(v)
 
@@ -125,11 +127,26 @@ def main(argv=None) -> int:
 
     if args.mode == "allreduce":
         r = measure_allreduce(payload_mb=args.payload_mb, iters=args.steps)
+        base = {}
+        if os.path.exists(BASELINE_FILE):
+            with open(BASELINE_FILE) as f:
+                base = json.load(f)
+        if args.record_baseline:
+            base["allreduce"] = r
+            with open(BASELINE_FILE, "w") as f:
+                json.dump(base, f, indent=2)
+            print(json.dumps({"recorded_baseline": r}))
+            return 0
+        vs = None
+        if base.get("allreduce", {}).get("allreduce_ms"):
+            # >1 means faster than the recorded baseline (time ratio)
+            vs = round(base["allreduce"]["allreduce_ms"] / r["allreduce_ms"],
+                       3)
         print(json.dumps({
             "metric": "gradient allreduce step time",
             "value": round(r["allreduce_ms"], 3),
             "unit": "ms",
-            "vs_baseline": None,
+            "vs_baseline": vs,
             "detail": r,
         }))
         return 0
@@ -137,8 +154,13 @@ def main(argv=None) -> int:
     result = measure(batch_size=args.batch_size, steps=args.steps)
 
     if args.record_baseline:
+        merged = {}
+        if os.path.exists(BASELINE_FILE):
+            with open(BASELINE_FILE) as f:
+                merged = json.load(f)
+        merged.update(result)
         with open(BASELINE_FILE, "w") as f:
-            json.dump(result, f, indent=2)
+            json.dump(merged, f, indent=2)
         print(json.dumps({"recorded_baseline": result}))
         return 0
 

@@ -1,0 +1,211 @@
+"""Pallas TPU kernels for the reduction-fusion ops, with honest benchmarks.
+
+The reference gets its fused elementwise+reduction kernels from the TF C++
+runtime (SURVEY.md §2 E2).  On TPU the equivalent roles are:
+
+- ``layer_norm``             single-pass mean/var/normalize/affine;
+- ``online_logsumexp``       one read of logits with the running max and
+                             exp-sum carried in VMEM scratch
+                             (flash-attention's softmax trick);
+- ``softmax_cross_entropy``  logsumexp kernel + gold-logit gather; the
+                             (N, V) softmax matrix is never materialized.
+
+**Measured verdict (TPU v5 lite, BERT-base shapes, in-graph loop timing):
+XLA's own fusion wins.**  logsumexp over (4096, 30522): XLA 2.23 ms vs the
+best Pallas config 3.16 ms; layer_norm over (4096, 768): parity.  XLA's
+two-pass reduction fusion already runs near HBM bandwidth, so the
+single-pass trick buys nothing a hand kernel can collect — consistent with
+the rule that Pallas pays only where the compiler *cannot* fuse (the O(S^2)
+flash-attention materialization, ops/flash_attention.py, 19x) rather than
+where it merely *might* do better.  The model paths therefore keep the XLA
+implementations; these kernels stay as verified building blocks for larger
+hand-written pipelines (where fusing the neighbor op into a Pallas kernel
+avoids an HBM round-trip XLA cannot see across a custom-call boundary).
+
+Backward passes recompute from the saved inputs (flash_attention.py's
+strategy): layer_norm grads via the closed-form JAX reference, CE grads as
+``softmax - onehot`` — both fuse into single XLA passes.
+
+All kernels take ``interpret=`` so the equivalence tests run on CPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_BIG = -1e30
+
+
+# ---------------------------------------------------------------------------
+# layer norm
+# ---------------------------------------------------------------------------
+
+def layer_norm_reference(x, scale, bias, eps: float = 1e-12):
+    """Two-pass JAX reference (matches models/bert.py:_layernorm)."""
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mu) * lax.rsqrt(var + eps) * scale + bias
+
+
+def _ln_kernel(x_ref, s_ref, b_ref, o_ref, *, eps: float):
+    x = x_ref[...].astype(jnp.float32)                 # (BN, F)
+    mu = jnp.mean(x, axis=-1, keepdims=True)
+    xc = x - mu
+    var = jnp.mean(xc * xc, axis=-1, keepdims=True)
+    y = xc * lax.rsqrt(var + eps) * s_ref[0] + b_ref[0]
+    o_ref[...] = y.astype(o_ref.dtype)
+
+
+def _ln_forward(x, scale, bias, eps: float, block_rows: int, interpret: bool):
+    orig_shape = x.shape
+    f = x.shape[-1]
+    x2 = x.reshape(-1, f)
+    n = x2.shape[0]
+    pad = (-n) % block_rows
+    if pad:
+        x2 = jnp.pad(x2, ((0, pad), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_ln_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+        grid=(x2.shape[0] // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, f), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, f), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, f), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2, scale.astype(jnp.float32).reshape(1, f),
+      bias.astype(jnp.float32).reshape(1, f))
+    if pad:
+        out = out[:n]
+    return out.reshape(orig_shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def layer_norm(x, scale, bias, eps: float = 1e-12, block_rows: int = 128,
+               interpret: bool = False):
+    """Fused single-pass LayerNorm over the last axis."""
+    return _ln_forward(x, scale, bias, eps, block_rows, interpret)
+
+
+def _ln_fwd(x, scale, bias, eps, block_rows, interpret):
+    return layer_norm(x, scale, bias, eps, block_rows, interpret), \
+        (x, scale, bias)
+
+
+def _ln_bwd(eps, block_rows, interpret, res, g):
+    x, scale, bias = res
+    _, vjp = jax.vjp(
+        lambda x, s, b: layer_norm_reference(x, s, b, eps), x, scale, bias)
+    return vjp(g)
+
+
+layer_norm.defvjp(_ln_fwd, _ln_bwd)
+
+
+# ---------------------------------------------------------------------------
+# online logsumexp + fused softmax cross-entropy
+# ---------------------------------------------------------------------------
+
+def _lse_kernel(x_ref, o_ref, m_scr, l_scr):
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_BIG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    s = x_ref[...].astype(jnp.float32)                  # (BN, BV)
+    m_prev = m_scr[:, 0:1]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+    l_new = l_scr[:, 0:1] * jnp.exp(m_prev - m_new) + \
+        jnp.sum(jnp.exp(s - m_new), axis=-1, keepdims=True)
+    m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+    l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _emit():
+        o_ref[...] = jnp.broadcast_to(
+            m_scr[:, 0:1] + jnp.log(l_scr[:, 0:1]), o_ref.shape)
+
+
+def online_logsumexp(x, *, block_rows: int = 128, block_v: int = 512,
+                     interpret: bool = False):
+    """Single-pass logsumexp over the last axis of ``x`` (any leading dims).
+
+    Carries the running max and exp-sum in VMEM scratch across vocab
+    blocks, so HBM sees each logit exactly once.
+    """
+    orig_lead = x.shape[:-1]
+    v = x.shape[-1]
+    x2 = x.reshape(-1, v)
+    n = x2.shape[0]
+    pad_n = (-n) % block_rows
+    bv = min(block_v, v)
+    pad_v = (-v) % bv
+    if pad_n or pad_v:
+        x2 = jnp.pad(x2, ((0, pad_n), (0, pad_v)),
+                     constant_values=NEG_BIG)
+    grid = (x2.shape[0] // block_rows, x2.shape[1] // bv)
+    out = pl.pallas_call(
+        _lse_kernel,
+        out_shape=jax.ShapeDtypeStruct((x2.shape[0], 128), jnp.float32),
+        grid=grid,
+        in_specs=[pl.BlockSpec((block_rows, bv), lambda i, j: (i, j),
+                               memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((block_rows, 128), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((block_rows, 128), jnp.float32),
+            pltpu.VMEM((block_rows, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2)
+    lse = out[:n, 0]
+    return lse.reshape(orig_lead)
+
+
+def _ce_reference(logits, labels):
+    """Per-position CE, the JAX reference (models/bert.py loss formula)."""
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - gold
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy(logits, labels, block_v: int = 512,
+                          interpret: bool = False):
+    """Fused sparse softmax cross-entropy: per-position loss, softmax never
+    materialized.  ``logits``: (..., V) float, ``labels``: (...) int."""
+    lse = online_logsumexp(logits, block_v=block_v, interpret=interpret)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return lse - gold.astype(jnp.float32)
+
+
+def _ce_fwd(logits, labels, block_v, interpret):
+    out = softmax_cross_entropy(logits, labels, block_v, interpret)
+    # save lse (cheap, (N,)) so the backward is one fused elementwise pass
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    lse = out + gold.astype(jnp.float32)
+    return out, (logits, labels, lse)
+
+
+def _ce_bwd(block_v, interpret, res, g):
+    logits, labels, lse = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    onehot = jax.nn.one_hot(labels, logits.shape[-1], dtype=jnp.float32)
+    grad = (p - onehot) * g[..., None]
+    return grad.astype(logits.dtype), None
+
+
+softmax_cross_entropy.defvjp(_ce_fwd, _ce_bwd)
