@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "default: all devices on one data axis")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--precision", choices=["fp32", "bf16"], default=d.precision,
+                   help="compute dtype for matmuls/convs (bf16 doubles MXU "
+                        "throughput; params and loss stay fp32)")
     return p
 
 
@@ -80,6 +83,7 @@ def config_from_args(args) -> Config:
         model=args.model, dataset=args.dataset,
         mesh_shape=parse_mesh(args.mesh),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+        precision=args.precision,
     )
 
 

@@ -55,6 +55,14 @@ class Config:
     checkpoint_dir: Optional[str] = None   # None = checkpointing off
     resume: bool = False                   # resume from latest in the dir
 
+    # --- precision (TPU-first: bf16 on the MXU, fp32 master params) ---
+    precision: str = "fp32"       # "fp32" | "bf16": compute dtype for the
+                                  # forward/backward matmuls+convs; parameters,
+                                  # optimizer state and loss stay float32.
+                                  # fp32 default keeps bit-level comparability
+                                  # with the reference (mpipy.py is float32
+                                  # throughout)
+
     # --- misc ---
     seed: int = 1                 # the reference seeds everything with 1
                                   # (mpipy.py:40, 43, 48, 52, 166)
@@ -68,3 +76,14 @@ class Config:
     def num_channels(self) -> int:
         """Input channels (1 for MNIST)."""
         return 1
+
+    @property
+    def compute_dtype(self):
+        """The jnp dtype the forward/backward matmuls run in."""
+        import jax.numpy as jnp
+
+        if self.precision == "bf16":
+            return jnp.bfloat16
+        if self.precision == "fp32":
+            return jnp.float32
+        raise ValueError(f"unknown precision {self.precision!r}")

@@ -23,7 +23,7 @@ decay applies to conv/fc weights (standard ResNet practice) via
 from __future__ import annotations
 
 import dataclasses
-from typing import Sequence
+from typing import Any, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -45,6 +45,13 @@ class ResNet:
     num_classes: int = 10
     cifar_stem: bool = True          # 3x3/1 stem (CIFAR) vs 7x7/2 + pool
     bn_momentum: float = 0.9
+    compute_dtype: Any = jnp.float32  # bf16: convs/matmuls on the MXU in
+                                      # bfloat16; BN statistics, params and
+                                      # logits stay float32
+
+    def _conv(self, x, w, stride: int = 1):
+        dt = self.compute_dtype
+        return nn.conv2d(x.astype(dt), w.astype(dt), stride=stride)
 
     # ---- init ----
 
@@ -132,7 +139,7 @@ class ResNet:
         mom = self.bn_momentum
         new_state = {"stages": []}
         stride = 1 if self.cifar_stem else 2
-        h = nn.conv2d(x, params["stem"]["w"], stride=stride)
+        h = self._conv(x, params["stem"]["w"], stride=stride)
         h, new_state["stem"] = nn.batch_norm(
             h, params["stem"]["bn"], state["stem"], train=train, momentum=mom)
         h = jax.nn.relu(h)
@@ -149,34 +156,36 @@ class ResNet:
             new_state["stages"].append(st_out)
 
         h = nn.global_avg_pool(h)
-        logits = h @ params["fc"]["w"] + params["fc"]["b"]
+        dt = self.compute_dtype
+        logits = (h.astype(dt) @ params["fc"]["w"].astype(dt)).astype(
+            jnp.float32) + params["fc"]["b"]
         return logits, new_state
 
     def _block_apply(self, p, s, x, stride, train, mom):
         ns = {}
         shortcut = x
         if "proj" in p:
-            shortcut = nn.conv2d(x, p["proj"], stride=stride)
+            shortcut = self._conv(x, p["proj"], stride=stride)
             shortcut, ns["bn_proj"] = nn.batch_norm(
                 shortcut, p["bn_proj"], s["bn_proj"], train=train, momentum=mom)
         if self.bottleneck:
-            h = nn.conv2d(x, p["conv1"], stride=1)
+            h = self._conv(x, p["conv1"], stride=1)
             h, ns["bn1"] = nn.batch_norm(h, p["bn1"], s["bn1"], train=train,
                                          momentum=mom)
             h = jax.nn.relu(h)
-            h = nn.conv2d(h, p["conv2"], stride=stride)
+            h = self._conv(h, p["conv2"], stride=stride)
             h, ns["bn2"] = nn.batch_norm(h, p["bn2"], s["bn2"], train=train,
                                          momentum=mom)
             h = jax.nn.relu(h)
-            h = nn.conv2d(h, p["conv3"], stride=1)
+            h = self._conv(h, p["conv3"], stride=1)
             h, ns["bn3"] = nn.batch_norm(h, p["bn3"], s["bn3"], train=train,
                                          momentum=mom)
         else:
-            h = nn.conv2d(x, p["conv1"], stride=stride)
+            h = self._conv(x, p["conv1"], stride=stride)
             h, ns["bn1"] = nn.batch_norm(h, p["bn1"], s["bn1"], train=train,
                                          momentum=mom)
             h = jax.nn.relu(h)
-            h = nn.conv2d(h, p["conv2"], stride=1)
+            h = self._conv(h, p["conv2"], stride=1)
             h, ns["bn2"] = nn.batch_norm(h, p["bn2"], s["bn2"], train=train,
                                          momentum=mom)
         return jax.nn.relu(h + shortcut), ns
@@ -193,13 +202,15 @@ class ResNet:
         return out
 
 
-def build(name: str, num_classes: int | None = None) -> ResNet:
+def build(name: str, num_classes: int | None = None,
+          compute_dtype: Any = jnp.float32) -> ResNet:
     if name == "resnet20":
         return ResNet(stage_sizes=(3, 3, 3), widths=(16, 32, 64),
                       bottleneck=False, num_classes=num_classes or 10,
-                      cifar_stem=True)
+                      cifar_stem=True, compute_dtype=compute_dtype)
     if name == "resnet50":
         return ResNet(stage_sizes=(3, 4, 6, 3),
                       widths=(256, 512, 1024, 2048), bottleneck=True,
-                      num_classes=num_classes or 1000, cifar_stem=False)
+                      num_classes=num_classes or 1000, cifar_stem=False,
+                      compute_dtype=compute_dtype)
     raise ValueError(f"unknown resnet variant {name!r}")

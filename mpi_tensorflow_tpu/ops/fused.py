@@ -104,8 +104,12 @@ def _ln_fwd(x, scale, bias, eps, block_rows, interpret):
 
 def _ln_bwd(eps, block_rows, interpret, res, g):
     x, scale, bias = res
+    # the Pallas forward emits x.dtype, so the incoming cotangent is x.dtype;
+    # the f32 scale/bias would otherwise promote the reference closure's
+    # output (and the cotangent jax.vjp expects) to float32
     _, vjp = jax.vjp(
-        lambda x, s, b: layer_norm_reference(x, s, b, eps), x, scale, bias)
+        lambda x, s, b: layer_norm_reference(x, s, b, eps).astype(g.dtype),
+        x, scale, bias)
     return vjp(g)
 
 

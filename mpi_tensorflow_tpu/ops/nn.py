@@ -55,6 +55,10 @@ def batch_norm(x, params: dict, state: dict, *, train: bool,
     the train step averages the updated running stats across shards so the
     replicated state stays in sync.
     """
+    # statistics and normalization always run in float32 — under a bfloat16
+    # compute policy the convs feed bf16 activations in, but variance in bf16
+    # loses too many mantissa bits (mixed-precision BN convention)
+    x = x.astype(jnp.float32)
     axes = tuple(range(x.ndim - 1))
     if train:
         mean = jnp.mean(x, axis=axes)

@@ -48,21 +48,26 @@ class TrainResult:
 
 
 def build_model(config: Config):
+    dt = config.compute_dtype
     if config.model == "mnist_cnn":
         return cnn_lib.MnistCnn(
             image_size=config.image_size,
             num_channels=config.num_channels,
             num_classes=config.num_classes,
             dropout_rate=config.dropout_rate,
+            compute_dtype=dt,
         )
     if config.model in ("resnet20", "resnet50"):
         from mpi_tensorflow_tpu.models import resnet
 
-        return resnet.build(config.model, num_classes=config.num_classes)
+        return resnet.build(config.model, num_classes=config.num_classes,
+                            compute_dtype=dt)
     if config.model == "bert_base":
+        import dataclasses as dc
+
         from mpi_tensorflow_tpu.models import bert
 
-        return bert.BertMlm(bert.BERT_BASE)
+        return bert.BertMlm(dc.replace(bert.BERT_BASE, dtype=dt))
     raise ValueError(f"unknown model {config.model!r}")
 
 

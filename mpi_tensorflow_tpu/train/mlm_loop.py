@@ -42,7 +42,10 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
               verbose: bool = True) -> MlmResult:
     mesh = mesh if mesh is not None else meshlib.make_mesh(config.mesh_shape)
     ndev = int(np.prod(list(mesh.shape.values())))
-    bert_cfg = bert_cfg or bert.BERT_BASE
+    if bert_cfg is None:
+        import dataclasses as dc
+
+        bert_cfg = dc.replace(bert.BERT_BASE, dtype=config.compute_dtype)
     model = bert.BertMlm(bert_cfg, mesh=mesh)
     tx = optax.adamw(learning_rate)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),

@@ -77,12 +77,9 @@ def optax_softmax_ce(logits, labels):
     return logz - gold
 
 
-def make_train_step(model, config, mesh, decay_steps: int):
-    """Synchronous-SGD step: per-shard grads -> ``pmean`` over ``data`` ->
-    identical momentum update on every shard.  Returns a jitted function
-    ``(state, batch, labels, rng) -> (state, metrics)`` with the state buffer
-    donated."""
-    schedule = reference_schedule(config, decay_steps)
+def _sync_step_body(model, config, schedule):
+    """The per-step body shared by the one-step and scan (multi-step)
+    compilations: per-shard grads -> allreduce -> momentum update."""
     loss_fn = make_loss_fn(model, config)
 
     def step(state: TrainState, batch, labels, rng):
@@ -107,9 +104,50 @@ def make_train_step(model, config, mesh, decay_steps: int):
                                      config.momentum)
         return TrainState(params, opt, new_mstate), {"loss": loss, "lr": lr}
 
+    return step
+
+
+def make_train_step(model, config, mesh, decay_steps: int):
+    """Synchronous-SGD step: per-shard grads -> ``pmean`` over ``data`` ->
+    identical momentum update on every shard.  Returns a jitted function
+    ``(state, batch, labels, rng) -> (state, metrics)`` with the state buffer
+    donated."""
+    schedule = reference_schedule(config, decay_steps)
+    step = _sync_step_body(model, config, schedule)
+
     sharded = jax.shard_map(
         step, mesh=mesh,
         in_specs=(P(), P("data"), P("data"), P()),
+        out_specs=(P(), P()),
+    )
+    return jax.jit(sharded, donate_argnums=0)
+
+
+def make_multi_train_step(model, config, mesh, decay_steps: int):
+    """K synchronous-SGD steps per dispatch via an on-device ``lax.scan``.
+
+    The reference pays a host round-trip every step (``sess.run`` with a
+    feed_dict, mpipy.py:85); the one-step path above already removes the data
+    copies but still dispatches once per step.  For small models the dispatch
+    latency dominates the device time, so the loop can stage K batches on
+    device — ``batches: (K, global_b, ...)``, ``labels: (K, global_b)`` — and
+    scan the identical step body K times with zero host involvement.
+    Semantically equivalent to K calls of ``make_train_step``'s function
+    (pinned by tests/test_train_step.py); metrics come back stacked (K,).
+    """
+    schedule = reference_schedule(config, decay_steps)
+    step = _sync_step_body(model, config, schedule)
+
+    def multi(state: TrainState, batches, labels, rng):
+        def body(s, xs):
+            b, l = xs
+            return step(s, b, l, rng)
+
+        return lax.scan(body, state, (batches, labels))
+
+    sharded = jax.shard_map(
+        multi, mesh=mesh,
+        in_specs=(P(), P(None, "data"), P(None, "data"), P()),
         out_specs=(P(), P()),
     )
     return jax.jit(sharded, donate_argnums=0)

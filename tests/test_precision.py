@@ -1,0 +1,118 @@
+"""Mixed precision: bf16 compute / fp32 master params.
+
+The reference is float32 end to end (TF-v1 defaults, mpipy.py:33-74); the
+TPU-first design adds a bf16 compute policy — matmuls/convs feed the MXU in
+bfloat16 while parameters, optimizer state, BN statistics and the loss stay
+float32.  These tests pin the dtype contract and that bf16 training still
+optimizes.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.models import bert, resnet
+from mpi_tensorflow_tpu.models.cnn import MnistCnn
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
+from mpi_tensorflow_tpu.train import loop, step as step_lib
+
+
+def _all_f32(tree) -> bool:
+    leaves = [x for x in jax.tree.leaves(tree)
+              if jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)]
+    return all(jnp.asarray(x).dtype == jnp.float32 for x in leaves)
+
+
+class TestDtypeContract:
+    def test_cnn_bf16_logits_and_grads_are_f32(self):
+        model = MnistCnn(compute_dtype=jnp.bfloat16)
+        params = model.init(jax.random.key(0))
+        assert _all_f32(params), "master params must stay float32"
+        x = jnp.ones((4, 28, 28, 1), jnp.float32)
+        logits = model.apply(params, x, train=False)
+        assert logits.dtype == jnp.float32
+
+        def loss(p):
+            return jnp.sum(model.apply(p, x, train=False) ** 2)
+
+        grads = jax.grad(loss)(params)
+        assert _all_f32(grads), "grads of f32 params must come back f32"
+        assert all(bool(jnp.all(jnp.isfinite(g)))
+                   for g in jax.tree.leaves(grads))
+
+    def test_resnet_bf16_state_stays_f32(self):
+        model = resnet.build("resnet20", compute_dtype=jnp.bfloat16)
+        params = model.init(jax.random.key(0))
+        state = model.init_state()
+        x = jnp.ones((2, 32, 32, 3), jnp.float32)
+        logits, new_state = model.apply_with_state(params, state, x,
+                                                   train=True)
+        assert logits.dtype == jnp.float32
+        assert _all_f32(new_state), "BN running stats must stay float32"
+
+    def test_bert_bf16_logits_f32(self):
+        cfg = dataclasses.replace(bert.BERT_TINY, dtype=jnp.bfloat16)
+        model = bert.BertMlm(cfg)
+        params = model.init(jax.random.key(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits = model.apply(params, tokens, train=False)
+        assert logits.dtype == jnp.float32
+
+
+class TestNumerics:
+    def test_bf16_forward_close_to_f32(self):
+        m32 = MnistCnn()
+        m16 = MnistCnn(compute_dtype=jnp.bfloat16)
+        params = m32.init(jax.random.key(3))
+        x = jax.random.normal(jax.random.key(4), (8, 28, 28, 1)) * 0.3
+        l32 = m32.apply(params, x, train=False)
+        l16 = m16.apply(params, x, train=False)
+        # bf16 has ~8 mantissa bits; logits are O(1)
+        np.testing.assert_allclose(np.asarray(l16), np.asarray(l32),
+                                   atol=0.15)
+
+    def test_bf16_training_reduces_loss(self):
+        cfg = Config(batch_size=16, precision="bf16")
+        mesh = meshlib.make_mesh()
+        model = loop.build_model(cfg)
+        assert model.compute_dtype == jnp.bfloat16
+        state = step_lib.init_state(model, jax.random.key(0))
+        train_step = step_lib.make_train_step(model, cfg, mesh,
+                                              decay_steps=1000)
+        n = 16 * meshlib.data_axis_size(mesh)
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(n, 28, 28, 1)).astype(np.float32) * 0.3
+        y = rng.integers(0, 10, size=(n,)).astype(np.int64)
+        key = jax.random.key(1)
+        losses = []
+        for _ in range(30):
+            state, metrics = train_step(state, x, y, key)
+            losses.append(float(metrics["loss"]))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0] * 0.7, losses[:3] + losses[-3:]
+
+
+class TestPlumbing:
+    def test_config_compute_dtype(self):
+        assert Config().compute_dtype == jnp.float32
+        assert Config(precision="bf16").compute_dtype == jnp.bfloat16
+        with pytest.raises(ValueError):
+            Config(precision="fp16").compute_dtype  # noqa: B018
+
+    def test_cli_flag(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(["--precision", "bf16"])
+        cfg = cli.config_from_args(args)
+        assert cfg.precision == "bf16"
+        assert cli.build_parser().parse_args([]).precision == "fp32"
+
+    def test_build_model_threads_dtype(self):
+        m = loop.build_model(Config(precision="bf16", model="resnet20"))
+        assert m.compute_dtype == jnp.bfloat16
+        b = loop.build_model(Config(precision="bf16", model="bert_base"))
+        assert b.cfg.dtype == jnp.bfloat16
