@@ -43,6 +43,11 @@ class BertConfig:
     max_positions: int = 512
     dropout: float = 0.1
     dtype: Any = jnp.float32      # compute dtype; bfloat16 for TPU throughput
+    sp_impl: str = "ring"         # sequence-parallel attention: "ring"
+                                  # (ppermute K/V hops, any head count) or
+                                  # "ulysses" (2 all-to-alls, needs heads
+                                  # divisible by the seq axis) —
+                                  # parallel/ring.py vs parallel/ulysses.py
 
     @property
     def head_dim(self) -> int:
@@ -143,15 +148,20 @@ class BertMlm:
         return rules_lib.constrain(x, axes, self.mesh, self.rules)
 
     def _attention(self, q, k, v):
-        """q,k,v: (B, H, S, D).  Ring attention over the seq axis when the
-        mesh shards it; otherwise the Pallas flash kernel on TPU (falls back
-        to dense when shapes/platform don't allow it)."""
+        """q,k,v: (B, H, S, D).  Sequence-parallel attention (ring or
+        Ulysses per ``cfg.sp_impl``) over the seq axis when the mesh shards
+        it; otherwise the Pallas flash kernel on TPU (falls back to dense
+        when shapes/platform don't allow it)."""
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             specs = P("data" if self.mesh.shape.get("data", 1) > 1 else None,
                       "model" if self.mesh.shape.get("model", 1) > 1 else None,
                       "seq")
 
             def inner(q, k, v):
+                if self.cfg.sp_impl == "ulysses":
+                    from mpi_tensorflow_tpu.parallel import ulysses
+
+                    return ulysses.ulysses_attention(q, k, v, "seq")
                 return ring.ring_attention(q, k, v, "seq")
 
             return jax.shard_map(inner, mesh=self.mesh,

@@ -1,0 +1,66 @@
+"""Ulysses (all-to-all) sequence parallelism — the second SP strategy.
+
+Ring attention (parallel/ring.py) keeps K/V moving around a ring of
+neighbors; DeepSpeed-Ulysses-style attention instead *re-shards* with two
+all-to-alls: heads are exchanged for sequence so every shard holds the FULL
+sequence for ``H / n`` heads, runs an ordinary (or flash) attention locally,
+and the output is re-sharded back to sequence-parallel layout.
+
+Trade-offs vs ring (why the framework offers both):
+- communication is 2 all-to-alls of the activations per attention call,
+  independent of sequence length — cheaper than the ring's ``n-1`` K/V hops
+  when heads are plentiful and ICI all-to-all bandwidth is good;
+- the local attention sees the full (S, S) score matrix for its heads, so
+  per-shard memory is O(S^2 / n) score rows with a plain kernel (the ring
+  stays O(S_local^2)) — pair it with the flash kernel for long S;
+- requires ``num_heads % n == 0``; the ring has no such constraint.
+
+No counterpart exists in the reference (no attention at all — SURVEY.md §2
+parallelism checklist); first-class long-context support is a framework goal.
+
+Call ``ulysses_attention`` inside ``shard_map`` with the ``seq`` axis in
+scope, exactly like ``ring.ring_attention`` (equivalence with dense attention
+on the gathered sequence is pinned in tests/test_ulysses.py).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from jax import lax
+
+from mpi_tensorflow_tpu.parallel import ring
+
+
+def ulysses_attention(q, k, v, axis_name: str = "seq", *,
+                      causal: bool = False, scale: Optional[float] = None,
+                      inner: Optional[Callable] = None):
+    """All-to-all sequence-parallel attention.
+
+    q, k, v: (B, H, S_local, D) per shard, sequence-sharded over
+    ``axis_name``.  Requires ``H`` divisible by the axis size.  ``inner``
+    overrides the local attention kernel (default: ``ring.dense_attention``;
+    pass a flash kernel for long sequences).
+    """
+    n = lax.axis_size(axis_name)
+    H = q.shape[1]
+    if H % n != 0:
+        raise ValueError(
+            f"ulysses needs num_heads ({H}) divisible by the '{axis_name}' "
+            f"axis size ({n}); use ring attention otherwise")
+    if n == 1:
+        attn = inner if inner is not None else ring.dense_attention
+        return attn(q, k, v, causal=causal, scale=scale)
+
+    # reshard: split heads, gather sequence -> (B, H/n, S_global, D).
+    # shard i holds sequence block i, so the tiled concat along axis 2
+    # reassembles blocks in global order.
+    qh = lax.all_to_all(q, axis_name, 1, 2, tiled=True)
+    kh = lax.all_to_all(k, axis_name, 1, 2, tiled=True)
+    vh = lax.all_to_all(v, axis_name, 1, 2, tiled=True)
+
+    attn = inner if inner is not None else ring.dense_attention
+    o = attn(qh, kh, vh, causal=causal, scale=scale)
+
+    # reshard back: split sequence, gather heads -> (B, H, S_local, D)
+    return lax.all_to_all(o, axis_name, 2, 1, tiled=True)
