@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "default: all devices on one data axis")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--grad-accum", type=int, default=d.grad_accum,
+                   help="microbatches accumulated per optimizer step "
+                        "(activation-memory / batch-size trade)")
     p.add_argument("--precision", choices=["fp32", "bf16"], default=d.precision,
                    help="compute dtype for matmuls/convs (bf16 doubles MXU "
                         "throughput; params and loss stay fp32)")
@@ -83,7 +86,7 @@ def config_from_args(args) -> Config:
         model=args.model, dataset=args.dataset,
         mesh_shape=parse_mesh(args.mesh),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
-        precision=args.precision,
+        precision=args.precision, grad_accum=args.grad_accum,
     )
 
 
@@ -95,12 +98,9 @@ def main(argv=None) -> int:
 
     meshlib.initialize_distributed()
 
-    profiling = args.profile_dir is not None
-    if profiling:
-        import jax
+    from mpi_tensorflow_tpu.utils import profiling
 
-        jax.profiler.start_trace(args.profile_dir)
-    try:
+    with profiling.trace(args.profile_dir):
         if config.model == "bert_base":
             from mpi_tensorflow_tpu.train import mlm_loop
 
@@ -109,11 +109,6 @@ def main(argv=None) -> int:
             from mpi_tensorflow_tpu.train import loop
 
             loop.train(config)
-    finally:
-        if profiling:
-            import jax
-
-            jax.profiler.stop_trace()
     return 0
 
 

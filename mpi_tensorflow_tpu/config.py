@@ -44,6 +44,10 @@ class Config:
                                   # parameter averaging, the reference's
                                   # strategy (mpipy.py:95-153) with the rank-0-
                                   # only bug fixed (all ranks receive the mean)
+    grad_accum: int = 1           # microbatches per step: grads accumulate
+                                  # on-device (lax.scan) before the single
+                                  # allreduce+update — same semantics, 1/A
+                                  # the activation memory
     scale_batch: bool = True      # True: per-device batch = batch_size, i.e.
                                   # global batch grows with the mesh — the
                                   # reference's behavior (each rank independently

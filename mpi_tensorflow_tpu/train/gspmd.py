@@ -21,6 +21,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -98,7 +99,8 @@ def shard_batch(tree: Any, mesh: Mesh):
 
 def make_gspmd_train_step(model, mesh: Mesh,
                           tx: optax.GradientTransformation,
-                          state_template: Optional[GspmdState] = None):
+                          state_template: Optional[GspmdState] = None,
+                          grad_accum: int = 1):
     """Full training step: loss -> grads -> optax update, all under one jit.
 
     ``model.loss(params, model_state, batch, labels, rng=..., train=True)``
@@ -108,17 +110,45 @@ def make_gspmd_train_step(model, mesh: Mesh,
     back to its input shardings — required for FSDP, where the compiler
     must re-scatter parameters and moments after the update instead of
     leaving them gathered.
+
+    ``grad_accum > 1`` splits the batch into that many microbatches and
+    accumulates their mean gradient in an on-device ``lax.scan`` before the
+    single optimizer update (same semantics, 1/A the activation memory).
     """
+    accum = max(1, int(grad_accum))
 
     def step(state: GspmdState, batch, labels, rng):
         rng = jax.random.fold_in(rng, state.step)
 
-        def lf(params):
-            loss, ms = model.loss(params, state.model_state, batch, labels,
-                                  rng=rng, train=True)
+        def lf(params, b, l, r):
+            loss, ms = model.loss(params, state.model_state, b, l,
+                                  rng=r, train=True)
             return loss, ms
 
-        (loss, ms), grads = jax.value_and_grad(lf, has_aux=True)(state.params)
+        if accum == 1:
+            (loss, ms), grads = jax.value_and_grad(lf, has_aux=True)(
+                state.params, batch, labels, rng)
+        else:
+            split = lambda x: x.reshape(accum, x.shape[0] // accum,
+                                        *x.shape[1:])
+            mb = jax.tree.map(split, batch)
+            ml = jax.tree.map(split, labels)
+
+            def micro(carry, xs):
+                g_acc, l_acc, _ = carry
+                b, l, i = xs
+                (loss, ms), g = jax.value_and_grad(lf, has_aux=True)(
+                    state.params, b, l, jax.random.fold_in(rng, i))
+                return (jax.tree.map(jnp.add, g_acc, g), l_acc + loss,
+                        ms), None
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, loss, ms), _ = lax.scan(
+                micro, (zeros, jnp.zeros(()), state.model_state),
+                (mb, ml, jnp.arange(accum)))
+            grads = jax.tree.map(lambda x: x / accum, grads)
+            loss = loss / accum
+
         updates, opt = tx.update(grads, state.opt, state.params)
         params = optax.apply_updates(state.params, updates)
         return (GspmdState(params, opt, ms, state.step + 1),

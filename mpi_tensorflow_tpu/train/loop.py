@@ -115,6 +115,12 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                                               decay_steps=local_n)
         eval_step = step_lib.make_eval_step(model, config, mesh)
     elif config.sync == "avg50":
+        if config.grad_accum > 1:
+            raise ValueError(
+                "grad_accum applies to the psum (sync-SGD) and transformer "
+                "paths; the avg50 fidelity mode reproduces the reference's "
+                "per-rank batch-64 stepping, where microbatching has no "
+                "counterpart")
         train_step = step_lib.make_local_train_step(model, config, mesh,
                                                     decay_steps=local_n)
         avg_step = step_lib.make_average_step(mesh)
@@ -139,6 +145,14 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     rng = jax.random.key(config.seed + 1)
     timer = StepTimer(warmup_steps=1)
     history = []
+    guard = None
+    if config.checkpoint_dir:
+        from mpi_tensorflow_tpu.train import preemption
+
+        try:
+            guard = preemption.PreemptionGuard.install()
+        except ValueError:
+            guard = None   # signal handlers need the main thread
     if verbose:
         logs.session_start(meshlib.process_index())
 
@@ -147,41 +161,63 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         return evaluation.eval_in_batches(predict, splits.test_data, global_b)
 
     pending = 0
-    timer.start()
-    for t in range(start_step, num_steps):
-        offset = (t * b) % (local_n - b)               # mpipy.py:80
-        batch = np.ascontiguousarray(
-            tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
-        labels = np.ascontiguousarray(
-            tr_l[:, offset:offset + b]).reshape(global_b)
-        batch = jax.device_put(batch, batch_sharding)
-        labels = jax.device_put(labels, batch_sharding)
-        state, metrics = train_step(state, batch, labels, rng)
-        pending += 1
 
-        last = t == num_steps - 1
-        if (t > 0 and t % config.log_every == 0) or last:
-            jax.block_until_ready(state)               # close the timed span
-            timer.stop(pending)
-            pending = 0
-            preds = run_eval(state)
-            global_err = error_rate(preds, splits.test_labels)
-            history.append((t, global_err))
-            if verbose:
-                # one line per shard, the reference's per-rank trace
-                for r, e in enumerate(evaluation.shard_error_rates(
-                        preds, splits.test_labels, ndev)):
-                    logs.step_trace(r, t, e)
-            if config.sync == "avg50" and not last:    # mpipy.py:91
-                state = avg_step(state)
-            if config.checkpoint_dir:
+    def run_steps():
+        nonlocal state, pending
+        for t in range(start_step, num_steps):
+            offset = (t * b) % (local_n - b)               # mpipy.py:80
+            batch = np.ascontiguousarray(
+                tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
+            labels = np.ascontiguousarray(
+                tr_l[:, offset:offset + b]).reshape(global_b)
+            batch = jax.device_put(batch, batch_sharding)
+            labels = jax.device_put(labels, batch_sharding)
+            state, metrics = train_step(state, batch, labels, rng)
+            pending += 1
+
+            if guard is not None and guard.should_stop:
+                # preemption: flush a checkpoint at the current step and leave —
+                # --resume continues from here (train/preemption.py)
                 from mpi_tensorflow_tpu.train import checkpoint
 
-                checkpoint.save(
-                    checkpoint.step_path(config.checkpoint_dir, t),
-                    state, step=t)
-            timer.start()
+                jax.block_until_ready(state)
+                checkpoint.save(checkpoint.step_path(config.checkpoint_dir, t),
+                                state, step=t)
+                if verbose:
+                    print(f"[preemption] {guard.reason}: checkpointed step {t}, "
+                          "exiting cleanly")
+                break
 
+            last = t == num_steps - 1
+            if (t > 0 and t % config.log_every == 0) or last:
+                jax.block_until_ready(state)               # close the timed span
+                timer.stop(pending)
+                pending = 0
+                preds = run_eval(state)
+                global_err = error_rate(preds, splits.test_labels)
+                history.append((t, global_err))
+                if verbose:
+                    # one line per shard, the reference's per-rank trace
+                    for r, e in enumerate(evaluation.shard_error_rates(
+                            preds, splits.test_labels, ndev)):
+                        logs.step_trace(r, t, e)
+                if config.sync == "avg50" and not last:    # mpipy.py:91
+                    state = avg_step(state)
+                if config.checkpoint_dir:
+                    from mpi_tensorflow_tpu.train import checkpoint
+
+                    checkpoint.save(
+                        checkpoint.step_path(config.checkpoint_dir, t),
+                        state, step=t)
+                timer.start()
+
+
+    timer.start()
+    try:
+        run_steps()
+    finally:
+        if guard is not None:
+            guard.uninstall()
     final_err = history[-1][1] if history else float("nan")
     ips = timer.images_per_sec(global_b)
     if verbose:

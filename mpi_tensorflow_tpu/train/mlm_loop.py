@@ -50,7 +50,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     tx = optax.adamw(learning_rate)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
                                    mesh)
-    train_step = gspmd.make_gspmd_train_step(model, mesh, tx)
+    train_step = gspmd.make_gspmd_train_step(
+        model, mesh, tx, grad_accum=getattr(config, "grad_accum", 1))
     eval_step = gspmd.make_gspmd_eval_step(model, mesh)
 
     tokens, targets, mask = synthetic.mlm_batches(

@@ -79,15 +79,60 @@ def optax_softmax_ce(logits, labels):
 
 def _sync_step_body(model, config, schedule):
     """The per-step body shared by the one-step and scan (multi-step)
-    compilations: per-shard grads -> allreduce -> momentum update."""
+    compilations: per-shard grads -> allreduce -> momentum update.
+
+    ``config.grad_accum > 1`` splits the per-shard batch into that many
+    microbatches and accumulates their mean gradient in an on-device
+    ``lax.scan`` before the (single) allreduce and update — same update
+    semantics, 1/A the activation memory (the standard way to hold the
+    global batch when activations don't fit HBM)."""
     loss_fn = make_loss_fn(model, config)
+    accum = int(getattr(config, "grad_accum", 1) or 1)
+
+    def grads_of(params, model_state, batch, labels, rng):
+        if accum <= 1:
+            return jax.value_and_grad(loss_fn, has_aux=True)(
+                params, model_state, batch, labels, rng)
+        n = batch.shape[0]
+        if n % accum:
+            raise ValueError(
+                f"per-shard batch {n} not divisible by grad_accum {accum}")
+        mb = batch.reshape(accum, n // accum, *batch.shape[1:])
+        ml = labels.reshape(accum, n // accum, *labels.shape[1:])
+
+        # differentiate w.r.t. a 'data'-varying view of the params so each
+        # microbatch yields LOCAL grads (no per-microbatch allreduce); one
+        # psum after the scan restores the replicated type the caller
+        # expects from the accum=1 path (where the autodiff transpose of
+        # the replicated params emits the psum itself)
+        to_varying = lambda t: jax.tree.map(
+            lambda x: lax.pcast(x, "data", to="varying"), t)
+        p_local = to_varying(params)
+
+        def micro(carry, xs):
+            g_acc, l_acc, mstate = carry
+            b, l, i = xs
+            (loss, new_ms), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                p_local, mstate, b, l, jax.random.fold_in(rng, i))
+            return (jax.tree.map(jnp.add, g_acc, g), l_acc + loss,
+                    new_ms), None
+
+        # accumulators carry the 'data'-varying type the body produces —
+        # cf. the same pattern in parallel/ring.py
+        zeros = to_varying(jax.tree.map(jnp.zeros_like, params))
+        (g, l, ms), _ = lax.scan(
+            micro, (zeros, to_varying(jnp.zeros(())),
+                    to_varying(model_state)),
+            (mb, ml, jnp.arange(accum)))
+        g = jax.tree.map(lambda x: lax.psum(x / accum, "data"), g)
+        return ((l / accum, ms), g)
 
     def step(state: TrainState, batch, labels, rng):
         # distinct dropout stream per shard and per step (derived in-graph —
         # the host passes one base key for the whole run)
         rng = jax.random.fold_in(rng, lax.axis_index("data"))
         rng = jax.random.fold_in(rng, state.opt.step.astype(jnp.int32))
-        (loss, new_mstate), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        (loss, new_mstate), grads = grads_of(
             state.params, state.model_state, batch, labels, rng)
         # shard_map autodiff inserts the gradient allreduce itself: the
         # cotangent of the replicated params is psum'd across 'data' (this IS

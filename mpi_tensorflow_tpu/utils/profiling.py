@@ -1,0 +1,65 @@
+"""Tracing / profiling subsystem.
+
+The reference has none — its only instrumentation is a commented-out
+wall-clock timer (mpipy.py:78) and the 50-step print trace (SURVEY.md §5
+tracing row).  Here profiling is a first-class utility:
+
+- ``trace(dir)``: context manager around ``jax.profiler`` — produces an
+  XPlane/TensorBoard trace of device + host activity;
+- ``annotate(name)``: names a region so it shows up in the trace timeline
+  (host side) and, via ``jax.named_scope``, in the compiled HLO;
+- ``device_memory_stats()``: per-device HBM usage snapshot, for finding the
+  working-set the rematerialization knobs should target.
+
+Wired into the CLI as ``--profile-dir`` (cli.py).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Capture a jax.profiler trace into ``log_dir`` (no-op when None)."""
+    if not log_dir:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Label a region in both the profiler timeline and the jaxpr/HLO."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
+        yield
+
+
+def device_memory_stats() -> list:
+    """Per-device memory snapshot: ``[{device, bytes_in_use, peak_bytes,
+    limit_bytes}, ...]``.  Platforms without stats report ``None`` fields."""
+    import jax
+
+    out = []
+    for d in jax.devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # not all platforms implement memory_stats
+            pass
+        out.append({
+            "device": str(d),
+            "bytes_in_use": stats.get("bytes_in_use"),
+            "peak_bytes": stats.get("peak_bytes_in_use"),
+            "limit_bytes": stats.get("bytes_limit"),
+        })
+    return out

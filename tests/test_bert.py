@@ -110,3 +110,32 @@ class TestGspmdStep:
             sharded_params, gspmd.shard_batch(jnp.array(tokens), mesh222))
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=5e-4, atol=5e-5)
+
+
+class TestGspmdGradAccum:
+    def test_accum_matches_full_batch(self, mesh222):
+        """grad_accum=2 microbatching == one full-batch step (dropout is 0
+        in BERT_TINY -> same loss/params up to float reassociation)."""
+        import dataclasses as dc
+
+        cfg = dc.replace(bert.BERT_TINY, dropout=0.0)
+        model = bert.BertMlm(cfg, mesh=mesh222)
+        tx = optax.sgd(1e-2)   # stateless optimizer -> exact comparison
+        batch, targets = mlm_batch(n=4, s=32)
+        batch_s = gspmd.shard_batch(batch, mesh222)
+        targets_s = gspmd.shard_batch(targets, mesh222)
+
+        s1 = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh222)
+        full = gspmd.make_gspmd_train_step(model, mesh222, tx)
+        s1, m1 = full(s1, batch_s, targets_s, jax.random.key(1))
+
+        s2 = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh222)
+        acc = gspmd.make_gspmd_train_step(model, mesh222, tx, grad_accum=2)
+        s2, m2 = acc(s2, batch_s, targets_s, jax.random.key(1))
+
+        assert float(m2["loss"]) == pytest.approx(float(m1["loss"]),
+                                                  rel=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6),
+            s2.params, s1.params)

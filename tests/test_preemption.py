@@ -1,0 +1,121 @@
+"""Preemption-safe training: SIGTERM -> checkpoint -> clean exit -> resume.
+
+The reference loses all progress on any failure (no checkpointing, SURVEY.md
+§5 failure row); this pins the cooperative-stop path end to end.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.train import checkpoint, loop, preemption
+
+
+class TestGuard:
+    def test_flag_starts_clear(self):
+        g = preemption.PreemptionGuard()
+        assert not g.should_stop
+
+    def test_request_stop_sets_flag_and_reason(self):
+        g = preemption.PreemptionGuard()
+        g.request_stop("test")
+        assert g.should_stop
+        assert g.reason == "test"
+
+    def test_real_signal_sets_flag(self):
+        g = preemption.PreemptionGuard.install(signals=(signal.SIGUSR1,))
+        try:
+            os.kill(os.getpid(), signal.SIGUSR1)
+            assert g.should_stop
+            assert "SIGUSR1" in g.reason
+        finally:
+            g.uninstall()
+
+    def test_uninstall_restores_previous_handler(self):
+        prev = signal.getsignal(signal.SIGUSR1)
+        g = preemption.PreemptionGuard.install(signals=(signal.SIGUSR1,))
+        g.uninstall()
+        assert signal.getsignal(signal.SIGUSR1) is prev
+
+
+@pytest.fixture()
+def tiny_splits():
+    from mpi_tensorflow_tpu.data import mnist
+
+    rng = np.random.default_rng(0)
+    mk = lambda n: rng.normal(size=(n, 28, 28, 1)).astype(np.float32)
+    lab = lambda n: rng.integers(0, 10, size=(n,)).astype(np.int64)
+    return mnist.Splits(mk(512), lab(512), mk(64), lab(64), mk(64), lab(64))
+
+
+class TestLoopIntegration:
+    def test_preempted_run_checkpoints_and_resumes(self, tmp_path,
+                                                   tiny_splits, mesh8):
+        """SIGTERM mid-run -> checkpoint written at the interrupted step;
+        --resume continues from there and finishes the full schedule."""
+        ckpt = str(tmp_path / "ckpt")
+        cfg = Config(batch_size=8, epochs=2, log_every=4,
+                     checkpoint_dir=ckpt, dropout_rate=0.0)
+
+        # deliver SIGTERM while training runs: an alarm-driven kill isn't
+        # deterministic, so instead trip the flag from inside the timed loop
+        # by aliasing the guard install to also schedule the signal
+        orig_install = preemption.PreemptionGuard.install
+
+        def install_and_preempt(*a, **k):
+            g = orig_install(*a, **k)
+            # simulate the eviction notice arriving after a few steps: the
+            # handler path is exercised by test_real_signal_sets_flag; here
+            # we trip the cooperative flag directly
+            g.request_stop("simulated eviction")
+            return g
+
+        preemption.PreemptionGuard.install = install_and_preempt
+        try:
+            r1 = loop.train(cfg, splits=tiny_splits, mesh=mesh8,
+                            verbose=False)
+        finally:
+            preemption.PreemptionGuard.install = orig_install
+
+        assert r1.num_steps > 1
+        last = checkpoint.latest_step(ckpt)
+        assert last is not None and last == 0   # stopped after the 1st step
+
+        cfg2 = Config(batch_size=8, epochs=2, log_every=4,
+                      checkpoint_dir=ckpt, resume=True, dropout_rate=0.0)
+        r2 = loop.train(cfg2, splits=tiny_splits, mesh=mesh8, verbose=False)
+        assert np.isfinite(r2.final_test_error)
+        # resumed run completed the remaining steps and checkpointed further
+        assert checkpoint.latest_step(ckpt) > last
+
+
+class TestProfilingUtils:
+    def test_trace_noop_without_dir(self):
+        from mpi_tensorflow_tpu.utils import profiling
+
+        with profiling.trace(None):
+            pass
+
+    def test_trace_writes_files(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        from mpi_tensorflow_tpu.utils import profiling
+
+        d = str(tmp_path / "prof")
+        with profiling.trace(d):
+            with profiling.annotate("tiny-matmul"):
+                jnp.ones((8, 8)).dot(jnp.ones((8, 8))).block_until_ready()
+        files = [os.path.join(r, f) for r, _, fs in os.walk(d) for f in fs]
+        assert files, "profiler trace produced no files"
+
+    def test_device_memory_stats_shape(self):
+        from mpi_tensorflow_tpu.utils import profiling
+
+        stats = profiling.device_memory_stats()
+        assert len(stats) >= 1
+        assert {"device", "bytes_in_use", "peak_bytes",
+                "limit_bytes"} <= set(stats[0])

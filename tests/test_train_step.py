@@ -104,6 +104,37 @@ class TestMultiStep:
         assert float(scanned.opt.step) == K
 
 
+class TestGradAccum:
+    def test_accum_matches_full_batch(self, mesh8, setup):
+        """A microbatches accumulated == one full-batch step (dropout off,
+        stateless model -> exact up to float reassociation)."""
+        cfg, model, _, batch, labels = setup
+        key = jax.random.key(0)
+
+        full = step.make_train_step(model, cfg, mesh8, decay_steps=1000)
+        s_full = step.init_state(model, jax.random.key(1))
+        s_full, m_full = full(s_full, batch, labels, key)
+
+        cfg2 = Config(batch_size=16, dropout_rate=0.0, grad_accum=2)
+        acc = step.make_train_step(model, cfg2, mesh8, decay_steps=1000)
+        s_acc = step.init_state(model, jax.random.key(1))
+        s_acc, m_acc = acc(s_acc, batch, labels, key)
+
+        assert float(m_acc["loss"]) == pytest.approx(float(m_full["loss"]),
+                                                     rel=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6),
+            s_acc.params, s_full.params)
+
+    def test_indivisible_batch_raises(self, mesh8, setup):
+        cfg, model, state, batch, labels = setup
+        cfg3 = Config(batch_size=16, dropout_rate=0.0, grad_accum=3)
+        bad = step.make_train_step(model, cfg3, mesh8, decay_steps=1000)
+        with pytest.raises(ValueError, match="divisible"):
+            bad(state, batch, labels, jax.random.key(0))
+
+
 class TestAvg50:
     def test_local_steps_diverge_then_average(self, mesh8, setup):
         cfg, model, state, batch, labels = setup
