@@ -57,6 +57,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "default: all devices on one data axis")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--fused-steps", type=int, default=None,
+                   help="train steps per device dispatch (lax.scan). "
+                        "Default: the --log-every cadence for psum mode "
+                        "(one dispatch per trace window), 1 for avg50. "
+                        "Pass 1 for the reference's one-dispatch-per-step "
+                        "shape")
     p.add_argument("--grad-accum", type=int, default=d.grad_accum,
                    help="microbatches accumulated per optimizer step "
                         "(activation-memory / batch-size trade)")
@@ -87,6 +93,8 @@ def config_from_args(args) -> Config:
         mesh_shape=parse_mesh(args.mesh),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         precision=args.precision, grad_accum=args.grad_accum,
+        fused_steps=(args.fused_steps if args.fused_steps is not None
+                     else (args.log_every if args.sync == "psum" else 1)),
     )
 
 

@@ -44,6 +44,14 @@ class Config:
                                   # parameter averaging, the reference's
                                   # strategy (mpipy.py:95-153) with the rank-0-
                                   # only bug fixed (all ranks receive the mean)
+    fused_steps: int = 1          # steps executed per device dispatch in the
+                                  # psum loop (lax.scan over staged batches,
+                                  # train/step.py make_multi_train_step).
+                                  # 1 = one dispatch per step (the
+                                  # reference's execution shape); the CLI
+                                  # defaults to the 50-step trace cadence on
+                                  # TPU, where dispatch latency dominates
+                                  # tiny steps
     grad_accum: int = 1           # microbatches per step: grads accumulate
                                   # on-device (lax.scan) before the single
                                   # allreduce+update — same semantics, 1/A

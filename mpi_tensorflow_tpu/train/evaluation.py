@@ -40,6 +40,41 @@ def eval_in_batches(predict_fn, data, batch_size: int) -> np.ndarray:
     return out
 
 
+def stack_eval_windows(data, batch_size: int):
+    """Assemble the eval windows ``eval_in_batches`` would run — full
+    batches plus the overlapped final window for the tail (mpipy.py:179-182)
+    — into one ``(K, batch_size, ...)`` array for a single scanned dispatch.
+
+    Returns ``(windows, starts)`` where ``starts[k]`` is the dataset row the
+    k-th window's predictions belong at (the tail window's overlap rows are
+    simply overwritten by design, exactly like the reference's slicing)."""
+    size = data.shape[0]
+    if size < batch_size:
+        raise ValueError(
+            "batch size for evals larger than dataset: %d" % size)
+    starts = list(range(0, size - batch_size + 1, batch_size))
+    if starts[-1] + batch_size < size:
+        starts.append(size - batch_size)   # overlapped tail window
+    windows = np.stack([np.asarray(data[s:s + batch_size]) for s in starts])
+    return windows, starts
+
+
+def eval_in_batches_fused(predict_multi_fn, data, batch_size: int
+                          ) -> np.ndarray:
+    """``eval_in_batches`` semantics in ONE device dispatch:
+    ``predict_multi_fn(windows) -> (K, batch_size, C)`` scans the forward
+    pass over staged windows (train/step.py make_multi_eval_step).  Per-
+    dispatch latency dominates batchwise eval on small models (and utterly
+    dominates through a tunneled device), so the host loop of the unfused
+    path becomes a single call."""
+    windows, starts = stack_eval_windows(data, batch_size)
+    preds = np.asarray(predict_multi_fn(windows))
+    out = np.empty((data.shape[0], preds.shape[-1]), dtype=np.float32)
+    for k, s in enumerate(starts):
+        out[s:s + batch_size] = preds[k]
+    return out
+
+
 def shard_error_rates(predictions: np.ndarray, labels: np.ndarray,
                       num_shards: int) -> list[float]:
     """Per-shard error %, matching the reference's per-rank printed trace

@@ -129,15 +129,28 @@ def make_gspmd_train_step(model, mesh: Mesh,
             (loss, ms), grads = jax.value_and_grad(lf, has_aux=True)(
                 state.params, batch, labels, rng)
         else:
-            split = lambda x: x.reshape(accum, x.shape[0] // accum,
-                                        *x.shape[1:])
+            def split(x):
+                if x.shape[0] % accum:
+                    raise ValueError(
+                        f"batch dim {x.shape[0]} not divisible by "
+                        f"grad_accum {accum}")
+                return x.reshape(accum, x.shape[0] // accum, *x.shape[1:])
+
             mb = jax.tree.map(split, batch)
             ml = jax.tree.map(split, labels)
 
             def micro(carry, xs):
-                g_acc, l_acc, _ = carry
+                g_acc, l_acc, mstate = carry
                 b, l, i = xs
-                (loss, ms), g = jax.value_and_grad(lf, has_aux=True)(
+
+                def lf_ms(params, b, l, r):
+                    # thread the running model state microbatch-to-
+                    # microbatch (matches the accum=1 path and the psum
+                    # implementation in step.py)
+                    return model.loss(params, mstate, b, l, rng=r,
+                                      train=True)
+
+                (loss, ms), g = jax.value_and_grad(lf_ms, has_aux=True)(
                     state.params, b, l, jax.random.fold_in(rng, i))
                 return (jax.tree.map(jnp.add, g_acc, g), l_acc + loss,
                         ms), None

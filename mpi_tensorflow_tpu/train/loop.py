@@ -156,65 +156,127 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     if verbose:
         logs.session_start(meshlib.process_index())
 
+    fused = max(1, int(config.fused_steps or 1)) if config.sync == "psum" else 1
+    eval_multi = None
+    if fused > 1:
+        eval_multi = step_lib.make_multi_eval_step(model, config, mesh)
+
     def run_eval(s):
+        if eval_multi is not None:
+            return evaluation.eval_in_batches_fused(
+                lambda w: eval_multi(s.params, s.model_state, w),
+                splits.test_data, global_b)
         predict = lambda b: eval_step(s.params, s.model_state, b)
         return evaluation.eval_in_batches(predict, splits.test_data, global_b)
 
     pending = 0
+    if fused > 1:
+        # +1: trace points land on completed step t with t % log_every == 0,
+        # so the first window is log_every+1 steps; the fixed K plus the
+        # n_valid mask keeps every window on ONE compiled shape
+        fused_k = fused + 1
+        multi_step = step_lib.make_multi_train_step(
+            model, config, mesh, decay_steps=local_n, masked=True)
+        fused_sharding = NamedSharding(mesh, P(None, "data"))
+
+    def slice_step(t):
+        offset = (t * b) % (local_n - b)                   # mpipy.py:80
+        batch = np.ascontiguousarray(
+            tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
+        labels = np.ascontiguousarray(
+            tr_l[:, offset:offset + b]).reshape(global_b)
+        return batch, labels
+
+    def preempt_checkpoint(t):
+        # preemption: flush a checkpoint at the current step and leave —
+        # --resume continues from here (train/preemption.py)
+        from mpi_tensorflow_tpu.train import checkpoint
+
+        jax.block_until_ready(state)
+        checkpoint.save(checkpoint.step_path(config.checkpoint_dir, t),
+                        state, step=t)
+        if verbose:
+            print(f"[preemption] {guard.reason}: checkpointed step {t}, "
+                  "exiting cleanly")
+
+    def run_steps_fused():
+        """One device dispatch per window of steps (lax.scan inside,
+        train/step.py make_multi_train_step): same step semantics, none of
+        the per-step dispatch latency.  Windows end exactly on the 50-step
+        trace cadence so the eval/avg/checkpoint schedule is unchanged."""
+        nonlocal state, pending
+        L = config.log_every
+        t = start_step
+        while t < num_steps:
+            # next step index at which the per-step loop would trace
+            T = min(((max(t, 1) + L - 1) // L) * L, num_steps - 1)
+            w = min(T - t + 1, fused_k)
+            # fixed-shape window: w real steps + (fused_k - w) masked ones
+            bs = np.zeros((fused_k,) + (global_b,) + tr_d.shape[2:],
+                          tr_d.dtype)
+            ls = np.zeros((fused_k, global_b), tr_l.dtype)
+            for j in range(w):
+                bs[j], ls[j] = slice_step(t + j)
+            bdev = jax.device_put(bs, fused_sharding)
+            ldev = jax.device_put(ls, fused_sharding)
+            state, _ = multi_step(state, bdev, ldev, rng, w)
+            pending += w
+            t_done = t + w - 1
+            t = t_done + 1
+
+            if guard is not None and guard.should_stop:
+                preempt_checkpoint(t_done)
+                break
+
+            if t_done == T and (t_done % L == 0 and t_done > 0
+                                or t_done == num_steps - 1):
+                trace_point(t_done)
+
+    def trace_point(t):
+        nonlocal state, pending
+        jax.block_until_ready(state)                   # close the timed span
+        timer.stop(pending)
+        pending = 0
+        preds = run_eval(state)
+        global_err = error_rate(preds, splits.test_labels)
+        history.append((t, global_err))
+        if verbose:
+            # one line per shard, the reference's per-rank trace
+            for r, e in enumerate(evaluation.shard_error_rates(
+                    preds, splits.test_labels, ndev)):
+                logs.step_trace(r, t, e)
+        if config.sync == "avg50" and t != num_steps - 1:  # mpipy.py:91
+            state = avg_step(state)
+        if config.checkpoint_dir:
+            from mpi_tensorflow_tpu.train import checkpoint
+
+            checkpoint.save(
+                checkpoint.step_path(config.checkpoint_dir, t),
+                state, step=t)
+        timer.start()
 
     def run_steps():
         nonlocal state, pending
         for t in range(start_step, num_steps):
-            offset = (t * b) % (local_n - b)               # mpipy.py:80
-            batch = np.ascontiguousarray(
-                tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
-            labels = np.ascontiguousarray(
-                tr_l[:, offset:offset + b]).reshape(global_b)
+            batch, labels = slice_step(t)
             batch = jax.device_put(batch, batch_sharding)
             labels = jax.device_put(labels, batch_sharding)
             state, metrics = train_step(state, batch, labels, rng)
             pending += 1
 
             if guard is not None and guard.should_stop:
-                # preemption: flush a checkpoint at the current step and leave —
-                # --resume continues from here (train/preemption.py)
-                from mpi_tensorflow_tpu.train import checkpoint
-
-                jax.block_until_ready(state)
-                checkpoint.save(checkpoint.step_path(config.checkpoint_dir, t),
-                                state, step=t)
-                if verbose:
-                    print(f"[preemption] {guard.reason}: checkpointed step {t}, "
-                          "exiting cleanly")
+                preempt_checkpoint(t)
                 break
 
-            last = t == num_steps - 1
-            if (t > 0 and t % config.log_every == 0) or last:
-                jax.block_until_ready(state)               # close the timed span
-                timer.stop(pending)
-                pending = 0
-                preds = run_eval(state)
-                global_err = error_rate(preds, splits.test_labels)
-                history.append((t, global_err))
-                if verbose:
-                    # one line per shard, the reference's per-rank trace
-                    for r, e in enumerate(evaluation.shard_error_rates(
-                            preds, splits.test_labels, ndev)):
-                        logs.step_trace(r, t, e)
-                if config.sync == "avg50" and not last:    # mpipy.py:91
-                    state = avg_step(state)
-                if config.checkpoint_dir:
-                    from mpi_tensorflow_tpu.train import checkpoint
-
-                    checkpoint.save(
-                        checkpoint.step_path(config.checkpoint_dir, t),
-                        state, step=t)
-                timer.start()
-
+            if (t > 0 and t % config.log_every == 0) or t == num_steps - 1:
+                trace_point(t)
 
     timer.start()
     try:
-        run_steps()
+        if fused > 1:
+            run_steps_fused()
+        else:
+            run_steps()
     finally:
         if guard is not None:
             guard.uninstall()

@@ -80,3 +80,61 @@ class TestCli:
 
         assert cli.parse_mesh("data=4,model=2") == {"data": 4, "model": 2}
         assert cli.parse_mesh(None) is None
+
+
+class TestFusedLoop:
+    def test_fused_matches_per_step(self, mesh8, splits):
+        """fused_steps>1 (scan windows) == per-step dispatch: same trace
+        schedule, matching error history and final params (dropout off)."""
+        import jax
+
+        cfg1 = small_config(dropout_rate=0.0, fused_steps=1)
+        r1 = loop.train(cfg1, splits=splits, mesh=mesh8, verbose=False)
+        cfg2 = small_config(dropout_rate=0.0, fused_steps=10)
+        r2 = loop.train(cfg2, splits=splits, mesh=mesh8, verbose=False)
+
+        assert [t for t, _ in r2.history] == [t for t, _ in r1.history]
+        for (_, e1), (_, e2) in zip(r1.history, r2.history):
+            assert e2 == pytest.approx(e1, abs=2.0)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-3),
+            r2.state.params, r1.state.params)
+
+    def test_fused_preemption_checkpoints(self, tmp_path, mesh8, splits):
+        from mpi_tensorflow_tpu.train import checkpoint, preemption
+
+        ckpt = str(tmp_path / "ck")
+        orig = preemption.PreemptionGuard.install
+
+        def install_and_stop(*a, **k):
+            g = orig(*a, **k)
+            g.request_stop("simulated")
+            return g
+
+        preemption.PreemptionGuard.install = install_and_stop
+        try:
+            cfg = small_config(dropout_rate=0.0, fused_steps=10,
+                               checkpoint_dir=ckpt)
+            loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        finally:
+            preemption.PreemptionGuard.install = orig
+        assert checkpoint.latest_step(ckpt) is not None
+
+    def test_fused_eval_matches_unfused(self, mesh8, splits):
+        """eval_in_batches_fused == eval_in_batches, incl. tail overlap."""
+        import jax
+
+        from mpi_tensorflow_tpu.train import evaluation, step as step_lib
+
+        cfg = small_config(dropout_rate=0.0)
+        model = loop.build_model(cfg)
+        state = step_lib.init_state(model, jax.random.key(0))
+        ev1 = step_lib.make_eval_step(model, cfg, mesh8)
+        evk = step_lib.make_multi_eval_step(model, cfg, mesh8)
+        data = splits.test_data[:200]     # 200 = 3 full windows of 64 + tail
+        a = evaluation.eval_in_batches(
+            lambda b: ev1(state.params, state.model_state, b), data, 64)
+        b = evaluation.eval_in_batches_fused(
+            lambda w: evk(state.params, state.model_state, w), data, 64)
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
