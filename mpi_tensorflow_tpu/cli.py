@@ -63,6 +63,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "(one dispatch per trace window), 1 for avg50. "
                         "Pass 1 for the reference's one-dispatch-per-step "
                         "shape")
+    p.add_argument("--prefetch", choices=["auto", "native", "thread", "off"],
+                   default=d.prefetch,
+                   help="background window assembly for the fused loop "
+                        "(native = C++ worker, data/prefetch.py)")
     p.add_argument("--grad-accum", type=int, default=d.grad_accum,
                    help="microbatches accumulated per optimizer step "
                         "(activation-memory / batch-size trade)")
@@ -93,6 +97,7 @@ def config_from_args(args) -> Config:
         mesh_shape=parse_mesh(args.mesh),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         precision=args.precision, grad_accum=args.grad_accum,
+        prefetch=args.prefetch,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
     )

@@ -52,6 +52,10 @@ class Config:
                                   # defaults to the 50-step trace cadence on
                                   # TPU, where dispatch latency dominates
                                   # tiny steps
+    prefetch: str = "auto"        # window-assembly prefetch for the fused
+                                  # loop: "auto" (native C++ worker when
+                                  # built, else Python thread), "native",
+                                  # "thread", "off" (inline assembly)
     grad_accum: int = 1           # microbatches per step: grads accumulate
                                   # on-device (lax.scan) before the single
                                   # allreduce+update — same semantics, 1/A

@@ -1,0 +1,190 @@
+"""Background window prefetch: batch assembly overlapped with device time.
+
+The reference assembles every batch inline in its Python loop
+(mpipy.py:80-82), serialized with ``sess.run``.  The fused training loop
+(train/loop.py) consumes whole *windows* — (K, global_b, ...) arrays, one
+per dispatch — whose assembly is a strided gather worth overlapping with
+the device's execution of the previous window.
+
+Two implementations behind one interface:
+
+- ``NativePrefetcher``: the C++ worker (native/prefetcher.cpp, ctypes) —
+  the framework's native data-loader component (SURVEY.md §2 E1/E2 role);
+- ``ThreadPrefetcher``: pure-Python thread + queue fallback, always
+  available.
+
+``make_prefetcher`` picks native when the library loads, else the thread
+fallback; tests pin both to the inline assembly byte-for-byte.
+
+The window schedule (start step, valid width) is computed by the caller —
+the trace-cadence logic stays in train/loop.py only.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libprefetcher.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_tried = False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    if not os.path.exists(_LIB_PATH):
+        try:
+            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                           capture_output=True, timeout=120)
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return None
+        if not os.path.exists(_LIB_PATH):
+            return None
+    try:
+        lib = ctypes.CDLL(_LIB_PATH)
+    except OSError:
+        return None
+    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    lib.pf_create.argtypes = [f32p, i64p] + [ctypes.c_int64] * 5 + \
+        [i64p, i64p, ctypes.c_int64, ctypes.c_int64]
+    lib.pf_create.restype = ctypes.c_void_p
+    lib.pf_next.argtypes = [ctypes.c_void_p, f32p, i64p]
+    lib.pf_next.restype = ctypes.c_int64
+    lib.pf_destroy.argtypes = [ctypes.c_void_p]
+    lib.pf_destroy.restype = None
+    _lib = lib
+    return _lib
+
+
+def assemble_window(tr_d, tr_l, t0: int, w: int, window_k: int,
+                    batch: int):
+    """Reference (inline) assembly of one window — the exact gather the
+    prefetchers perform, used directly when prefetch is off and by tests as
+    the golden implementation.  ``tr_d``: (n_shards, local_n, ...feat),
+    ``tr_l``: (n_shards, local_n)."""
+    n_shards, local_n = tr_l.shape
+    global_b = n_shards * batch
+    bs = np.zeros((window_k, global_b) + tr_d.shape[2:], tr_d.dtype)
+    ls = np.zeros((window_k, global_b), tr_l.dtype)
+    for j in range(w):
+        off = ((t0 + j) * batch) % (local_n - batch)       # mpipy.py:80
+        bs[j] = tr_d[:, off:off + batch].reshape(global_b, *tr_d.shape[2:])
+        ls[j] = tr_l[:, off:off + batch].reshape(global_b)
+    return bs, ls
+
+
+class ThreadPrefetcher:
+    """Python-thread implementation: assembles windows ahead into a bounded
+    queue (double buffering by default)."""
+
+    def __init__(self, tr_d, tr_l, starts, widths, window_k: int,
+                 batch: int, depth: int = 2):
+        self._q: queue.Queue = queue.Queue(maxsize=depth)
+        self._n = len(starts)
+        self._stop = threading.Event()
+
+        def work():
+            for t0, w in zip(starts, widths):
+                if self._stop.is_set():
+                    return
+                item = assemble_window(tr_d, tr_l, int(t0), int(w),
+                                       window_k, batch) + (int(w),)
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=work, daemon=True)
+        self._thread.start()
+
+    def next(self):
+        """-> (batches, labels, width) or None when exhausted."""
+        if self._n == 0:
+            return None
+        self._n -= 1
+        return self._q.get()
+
+    def close(self):
+        # stop the worker promptly (a preemption exit must not wait for the
+        # rest of the schedule to be assembled)
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+
+
+class NativePrefetcher:
+    """C++ worker (native/prefetcher.cpp).  Arrays are borrowed by the
+    library — this object keeps references so they outlive the worker."""
+
+    def __init__(self, lib, tr_d, tr_l, starts, widths, window_k: int,
+                 batch: int, depth: int = 2):
+        n_shards, local_n = tr_l.shape
+        feat = int(np.prod(tr_d.shape[2:], dtype=np.int64))
+        self._lib = lib
+        self._feat_shape = tr_d.shape[2:]
+        self._global_b = n_shards * batch
+        self._window_k = window_k
+        # borrowed by C++: keep alive + contiguous
+        self._d = np.ascontiguousarray(tr_d, dtype=np.float32)
+        self._l = np.ascontiguousarray(tr_l, dtype=np.int64)
+        self._starts = np.asarray(starts, np.int64)
+        self._widths = np.asarray(widths, np.int64)
+        self._n = len(starts)
+        self._h = lib.pf_create(
+            self._d.reshape(-1, feat), self._l, n_shards, local_n, feat,
+            batch, window_k, self._starts, self._widths, self._n, depth)
+        if not self._h:
+            raise RuntimeError("pf_create failed")
+
+    def next(self):
+        if self._n == 0:
+            return None
+        bs = np.empty((self._window_k, self._global_b) + self._feat_shape,
+                      np.float32)
+        ls = np.empty((self._window_k, self._global_b), np.int64)
+        w = self._lib.pf_next(self._h, bs.reshape(bs.shape[0], -1), ls)
+        if w == 0:
+            return None
+        self._n -= 1
+        return bs, ls, int(w)
+
+    def close(self):
+        if self._h:
+            self._lib.pf_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def make_prefetcher(tr_d, tr_l, starts, widths, window_k: int, batch: int,
+                    depth: int = 2, force: Optional[str] = None):
+    """Native when available (or ``force="native"``), else the thread
+    fallback (``force="thread"``)."""
+    lib = get_lib() if force in (None, "native") else None
+    if force == "native" and lib is None:
+        raise RuntimeError("native prefetcher library unavailable")
+    if lib is not None:
+        # NativePrefetcher converts to float32/int64 via ascontiguousarray,
+        # so any input dtype is accepted
+        return NativePrefetcher(lib, tr_d, tr_l, starts, widths, window_k,
+                                batch, depth)
+    return ThreadPrefetcher(tr_d, tr_l, starts, widths, window_k, batch,
+                            depth)

@@ -180,12 +180,12 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         fused_sharding = NamedSharding(mesh, P(None, "data"))
 
     def slice_step(t):
-        offset = (t * b) % (local_n - b)                   # mpipy.py:80
-        batch = np.ascontiguousarray(
-            tr_d[:, offset:offset + b]).reshape(global_b, *tr_d.shape[2:])
-        labels = np.ascontiguousarray(
-            tr_l[:, offset:offset + b]).reshape(global_b)
-        return batch, labels
+        # single window of width 1 — the wraparound-offset semantics live
+        # in data/prefetch.assemble_window only (one place per language)
+        from mpi_tensorflow_tpu.data import prefetch
+
+        bs, ls = prefetch.assemble_window(tr_d, tr_l, t, 1, 1, b)
+        return bs[0], ls[0]
 
     def preempt_checkpoint(t):
         # preemption: flush a checkpoint at the current step and leave —
@@ -199,38 +199,61 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
             print(f"[preemption] {guard.reason}: checkpointed step {t}, "
                   "exiting cleanly")
 
-    def run_steps_fused():
-        """One device dispatch per window of steps (lax.scan inside,
-        train/step.py make_multi_train_step): same step semantics, none of
-        the per-step dispatch latency.  Windows end exactly on the 50-step
-        trace cadence so the eval/avg/checkpoint schedule is unchanged."""
-        nonlocal state, pending
+    def window_schedule():
+        """(starts, widths): fixed-K windows ending exactly on the 50-step
+        trace cadence, so the eval/avg/checkpoint schedule matches the
+        per-step loop."""
         L = config.log_every
+        starts, widths = [], []
         t = start_step
         while t < num_steps:
             # next step index at which the per-step loop would trace
             T = min(((max(t, 1) + L - 1) // L) * L, num_steps - 1)
             w = min(T - t + 1, fused_k)
-            # fixed-shape window: w real steps + (fused_k - w) masked ones
-            bs = np.zeros((fused_k,) + (global_b,) + tr_d.shape[2:],
-                          tr_d.dtype)
-            ls = np.zeros((fused_k, global_b), tr_l.dtype)
-            for j in range(w):
-                bs[j], ls[j] = slice_step(t + j)
-            bdev = jax.device_put(bs, fused_sharding)
-            ldev = jax.device_put(ls, fused_sharding)
-            state, _ = multi_step(state, bdev, ldev, rng, w)
-            pending += w
-            t_done = t + w - 1
-            t = t_done + 1
+            starts.append(t)
+            widths.append(w)
+            t += w
+        return starts, widths
 
-            if guard is not None and guard.should_stop:
-                preempt_checkpoint(t_done)
-                break
+    def run_steps_fused():
+        """One device dispatch per window of steps (lax.scan inside,
+        train/step.py make_multi_train_step): same step semantics, none of
+        the per-step dispatch latency.  Window assembly (a strided gather)
+        runs ahead on a background worker — native C++ when available
+        (data/prefetch.py) — overlapping the device's previous window."""
+        nonlocal state, pending
+        from mpi_tensorflow_tpu.data import prefetch
 
-            if t_done == T and (t_done % L == 0 and t_done > 0
-                                or t_done == num_steps - 1):
-                trace_point(t_done)
+        L = config.log_every
+        starts, widths = window_schedule()
+        pf = None
+        if config.prefetch != "off":
+            force = None if config.prefetch == "auto" else config.prefetch
+            pf = prefetch.make_prefetcher(tr_d, tr_l, starts, widths,
+                                          fused_k, b, force=force)
+        try:
+            for t0, w in zip(starts, widths):
+                if pf is not None:
+                    bs, ls, _ = pf.next()
+                else:
+                    bs, ls = prefetch.assemble_window(tr_d, tr_l, t0, w,
+                                                      fused_k, b)
+                bdev = jax.device_put(bs, fused_sharding)
+                ldev = jax.device_put(ls, fused_sharding)
+                state, _ = multi_step(state, bdev, ldev, rng, w)
+                pending += w
+                t_done = t0 + w - 1
+
+                if guard is not None and guard.should_stop:
+                    preempt_checkpoint(t_done)
+                    break
+
+                if (t_done % L == 0 and t_done > 0) \
+                        or t_done == num_steps - 1:
+                    trace_point(t_done)
+        finally:
+            if pf is not None:
+                pf.close()
 
     def trace_point(t):
         nonlocal state, pending

@@ -1,0 +1,181 @@
+// Native background window prefetcher.
+//
+// The reference's data path is host-side numpy slicing inside the Python
+// training loop (mpipy.py:80-82), serialized with everything else.  Here the
+// per-window batch assembly (a strided gather of per-shard rows into one
+// contiguous (K, global_b, feat) buffer) runs on a C++ worker thread over a
+// ring of slots, overlapping the device's execution of the previous window —
+// the native data-loader role of SURVEY.md §2 E1/E2, like the IDX parser in
+// idx_loader.cpp.
+//
+// The window schedule (start step + valid width per window) is computed once
+// in Python (train/loop.py knows the trace cadence) and passed in, so the
+// wraparound-offset semantics live in exactly one place per language, pinned
+// equal by tests/test_native.py.
+//
+// C ABI (consumed via ctypes in mpi_tensorflow_tpu/data/prefetch.py):
+//   pf_create(...)  -> opaque handle (starts worker thread)
+//   pf_next(h, out_batch, out_labels) -> window width w (>0), 0 at end
+//   pf_destroy(h)
+//
+// Build: `make -C native` (g++ -O3 -shared -fPIC prefetcher.cpp -lpthread).
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<float> batch;     // (K * global_b * feat)
+  std::vector<int64_t> labels;  // (K * global_b)
+  int64_t width = 0;            // valid steps in this window
+  bool ready = false;
+};
+
+struct Prefetcher {
+  // source arrays (borrowed; caller keeps them alive)
+  const float* data = nullptr;      // (n_shards, local_n, feat)
+  const int64_t* labels = nullptr;  // (n_shards, local_n)
+  int64_t n_shards = 0, local_n = 0, feat = 0, batch = 0, window_k = 0;
+
+  // schedule
+  std::vector<int64_t> starts, widths;
+  size_t next_fill = 0;   // window index the worker fills next
+  size_t next_read = 0;   // window index the consumer takes next
+
+  std::vector<Slot> ring;
+  std::mutex mu;
+  std::condition_variable cv_fill, cv_read;
+  bool stop = false;
+  std::thread worker;
+
+  void fill(Slot& s, int64_t win) {
+    const int64_t t0 = starts[win], w = widths[win];
+    const int64_t row = batch * feat;             // floats per shard-slice
+    const int64_t global_b = n_shards * batch;
+    s.width = w;
+    for (int64_t j = 0; j < w; ++j) {
+      const int64_t t = t0 + j;
+      const int64_t off = (t * batch) % (local_n - batch);  // mpipy.py:80
+      float* out_b = s.batch.data() + j * global_b * feat;
+      int64_t* out_l = s.labels.data() + j * global_b;
+      for (int64_t sh = 0; sh < n_shards; ++sh) {
+        std::memcpy(out_b + sh * row,
+                    data + (sh * local_n + off) * feat,
+                    sizeof(float) * row);
+        std::memcpy(out_l + sh * batch, labels + sh * local_n + off,
+                    sizeof(int64_t) * batch);
+      }
+    }
+    // zero the masked tail so padded steps see deterministic input
+    for (int64_t j = w; j < window_k; ++j) {
+      std::memset(s.batch.data() + j * global_b * feat, 0,
+                  sizeof(float) * global_b * feat);
+      std::memset(s.labels.data() + j * global_b, 0,
+                  sizeof(int64_t) * global_b);
+    }
+  }
+
+  void run() {
+    for (;;) {
+      size_t win;
+      Slot* slot;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_fill.wait(lk, [&] {
+          return stop || (next_fill < starts.size() &&
+                          !ring[next_fill % ring.size()].ready);
+        });
+        if (stop || next_fill >= starts.size()) return;
+        win = next_fill++;
+        slot = &ring[win % ring.size()];
+      }
+      fill(*slot, static_cast<int64_t>(win));
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        slot->ready = true;
+      }
+      cv_read.notify_one();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pf_create(const float* data, const int64_t* labels, int64_t n_shards,
+                int64_t local_n, int64_t feat, int64_t batch,
+                int64_t window_k, const int64_t* starts,
+                const int64_t* widths, int64_t n_windows, int64_t depth) {
+  if (!data || !labels || n_shards <= 0 || local_n <= batch || feat <= 0 ||
+      batch <= 0 || window_k <= 0 || n_windows < 0 || depth <= 0) {
+    return nullptr;
+  }
+  auto* p = new Prefetcher();
+  p->data = data;
+  p->labels = labels;
+  p->n_shards = n_shards;
+  p->local_n = local_n;
+  p->feat = feat;
+  p->batch = batch;
+  p->window_k = window_k;
+  p->starts.assign(starts, starts + n_windows);
+  p->widths.assign(widths, widths + n_windows);
+  p->ring.resize(static_cast<size_t>(depth));
+  const int64_t global_b = n_shards * batch;
+  for (auto& s : p->ring) {
+    s.batch.resize(static_cast<size_t>(window_k * global_b * feat));
+    s.labels.resize(static_cast<size_t>(window_k * global_b));
+  }
+  p->worker = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Copy the next ready window into caller buffers sized (window_k, global_b,
+// feat) / (window_k, global_b).  Returns the window's valid width, or 0
+// when the schedule is exhausted.
+int64_t pf_next(void* handle, float* out_batch, int64_t* out_labels) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  Slot* slot;
+  {
+    std::unique_lock<std::mutex> lk(p->mu);
+    if (p->next_read >= p->starts.size()) return 0;
+    const size_t idx = p->next_read % p->ring.size();
+    // stop in the predicate (and cv_read notified by pf_destroy): a
+    // destroy racing a blocked consumer must wake it, not deadlock it
+    p->cv_read.wait(lk, [&] { return p->stop || p->ring[idx].ready; });
+    if (p->stop) return 0;
+    slot = &p->ring[idx];
+  }
+  std::memcpy(out_batch, slot->batch.data(),
+              sizeof(float) * slot->batch.size());
+  std::memcpy(out_labels, slot->labels.data(),
+              sizeof(int64_t) * slot->labels.size());
+  const int64_t w = slot->width;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    slot->ready = false;
+    p->next_read++;
+  }
+  p->cv_fill.notify_one();
+  return w;
+}
+
+void pf_destroy(void* handle) {
+  auto* p = static_cast<Prefetcher*>(handle);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->stop = true;
+  }
+  p->cv_fill.notify_all();
+  p->cv_read.notify_all();
+  if (p->worker.joinable()) p->worker.join();
+  delete p;
+}
+
+}  // extern "C"
