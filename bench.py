@@ -22,9 +22,93 @@ BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "BASELINE_MEASURED.json")
 
 
+# per-model measurement shapes: batch/chip, input geometry, scan window
+# (sized so the staged (K, B, ...) input bank fits HBM), total timed steps
+MODEL_SPECS = {
+    "mnist_cnn": dict(batch=64, shape=(28, 28, 1), classes=10,
+                      scan=400, steps=4000, unit="images"),
+    "resnet20": dict(batch=128, shape=(32, 32, 3), classes=10,
+                     scan=50, steps=500, unit="images"),
+    "resnet50": dict(batch=32, shape=(224, 224, 3), classes=1000,
+                     scan=8, steps=48, unit="images"),
+    "bert_base": dict(batch=16, seq=128, scan=8, steps=48, unit="tokens"),
+}
+
+
+def _measure_scanned(multi_step, state, batches, labels, key, scan_steps,
+                     iters, warmup_calls):
+    """Median seconds/step over ``iters`` scanned dispatches.  The value
+    fetch is the sync point — block_until_ready does not reliably await
+    completion through a tunneled (axon) device; the median resists the
+    shared chip's occasional multi-second tenancy stalls."""
+    import time
+
+    for _ in range(warmup_calls):
+        state, m = multi_step(state, batches, labels, key)
+        float(m["loss"][-1])
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        state, m = multi_step(state, batches, labels, key)
+        float(m["loss"][-1])
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] / scan_steps
+
+
+def measure_bert(batch_size: int, steps: int, precision: str,
+                 scan_steps: int, seq_len: int = 128) -> dict:
+    """BERT-base MLM train-step throughput (BASELINE config 5) via the
+    GSPMD path — adamw, tied-decoder MLM loss, scanned dispatches."""
+    import dataclasses as dc
+
+    import jax
+    import numpy as np
+    import optax
+
+    from mpi_tensorflow_tpu.config import Config
+    from mpi_tensorflow_tpu.data import synthetic
+    from mpi_tensorflow_tpu.models import bert
+    from mpi_tensorflow_tpu.parallel import mesh as meshlib
+    from mpi_tensorflow_tpu.train import gspmd
+
+    cfg = Config(precision=precision)
+    mesh = meshlib.make_mesh()
+    ndev = meshlib.data_axis_size(mesh)
+    global_b = batch_size * ndev
+    bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype)
+    model = bert.BertMlm(bcfg, mesh=mesh)
+    tx = optax.adamw(1e-4)
+    state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
+    multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
+
+    K = max(1, min(scan_steps, steps))
+    toks, tgts, mask = synthetic.mlm_batches(
+        K * global_b, seq_len=seq_len, vocab_size=bcfg.vocab_size, seed=0)
+    shape = (K, global_b, seq_len)
+    batches = gspmd.shard_batch(
+        {"tokens": toks.reshape(shape), "mask": mask.reshape(shape)}, mesh)
+    labels = gspmd.shard_batch(tgts.reshape(shape), mesh)
+
+    sec = _measure_scanned(multi, state, batches, labels, jax.random.key(1),
+                           K, max(1, steps // K), warmup_calls=2)
+    return {
+        "model": "bert_base",
+        "tokens_per_sec_per_chip": batch_size * seq_len / sec,
+        "examples_per_sec_per_chip": batch_size / sec,
+        "step_time_ms": sec * 1e3,
+        "num_devices": ndev,
+        "batch_size_per_chip": batch_size,
+        "seq_len": seq_len,
+        "precision": precision,
+        "scan_steps": K,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
-            precision: str = "fp32", scan_steps: int = 50) -> dict:
-    """Train-step throughput.
+            precision: str = "fp32", scan_steps: int = 50,
+            model_name: str = "mnist_cnn") -> dict:
+    """Train-step throughput for the image families.
 
     ``scan_steps > 0`` stages K batches on device and runs K steps per
     dispatch via ``lax.scan`` (train.step.make_multi_train_step) — measuring
@@ -41,7 +125,11 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     from mpi_tensorflow_tpu.train import loop, step as step_lib
     from mpi_tensorflow_tpu.utils.timing import time_step_fn
 
-    cfg = Config(batch_size=batch_size, precision=precision)
+    spec = MODEL_SPECS[model_name]
+    in_shape = spec["shape"]
+    cfg = Config(batch_size=batch_size, precision=precision,
+                 model=model_name, num_classes=spec["classes"],
+                 image_size=in_shape[0])
     mesh = meshlib.make_mesh()
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
@@ -54,45 +142,32 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
 
     key = jax.random.key(0)
     if scan_steps > 0:
-        import time
-
         scan_steps = min(scan_steps, steps)   # never exceed the requested work
         train_step = step_lib.make_multi_train_step(model, cfg, mesh,
                                                     decay_steps=50000)
         sh = NamedSharding(mesh, P(None, "data"))
         batches = jax.device_put(
-            rng.normal(size=(scan_steps, global_b, 28, 28, 1))
+            rng.normal(size=(scan_steps, global_b) + in_shape)
             .astype(np.float32) * 0.3, sh)
         labels = jax.device_put(
-            rng.integers(0, 10, size=(scan_steps, global_b))
+            rng.integers(0, spec["classes"], size=(scan_steps, global_b))
             .astype(np.int64), sh)
         iters = max(1, steps // scan_steps)
-        # compile + settle; the value fetch is the sync point —
-        # block_until_ready does not reliably await completion through a
-        # tunneled (axon) device, a value fetch must.  ``warmup`` counts
-        # single steps, like the non-scan path; convert to whole dispatches.
-        for _ in range(max(1, warmup // scan_steps) + 1):
-            state, m = train_step(state, batches, labels, key)
-            float(m["loss"][-1])
-        # median over calls: the shared chip shows occasional multi-second
-        # tenancy stalls that would corrupt a mean
-        times = []
-        for _ in range(iters):
-            t0 = time.perf_counter()
-            state, m = train_step(state, batches, labels, key)
-            float(m["loss"][-1])
-            times.append(time.perf_counter() - t0)
-        sec_per_step = sorted(times)[len(times) // 2] / scan_steps
+        # ``warmup`` counts single steps, like the non-scan path
+        sec_per_step = _measure_scanned(
+            train_step, state, batches, labels, key, scan_steps, iters,
+            warmup_calls=max(1, warmup // scan_steps) + 1)
     else:
         train_step = step_lib.make_train_step(model, cfg, mesh,
                                               decay_steps=50000)
         sh = NamedSharding(mesh, P("data"))
         n_banks = 4  # rotate buffers so steps don't alias one input
         batches = [jax.device_put(
-            rng.normal(size=(global_b, 28, 28, 1)).astype(np.float32) * 0.3,
+            rng.normal(size=(global_b,) + in_shape).astype(np.float32) * 0.3,
             sh) for _ in range(n_banks)]
         labels = [jax.device_put(
-            rng.integers(0, 10, size=(global_b,)).astype(np.int64), sh)
+            rng.integers(0, spec["classes"],
+                         size=(global_b,)).astype(np.int64), sh)
             for _ in range(n_banks)]
         sec_per_step, _ = time_step_fn(
             train_step, state,
@@ -100,6 +175,7 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
             iters=steps, warmup=warmup)
 
     return {
+        "model": model_name,
         "images_per_sec": global_b / sec_per_step,
         "images_per_sec_per_chip": batch_size / sec_per_step,
         "step_time_ms": sec_per_step * 1e3,
@@ -184,9 +260,12 @@ def main(argv=None) -> int:
                     help="total timed iterations. Default: 4000 train steps "
                          "(large enough that the ~80ms tunnel round-trip is "
                          "<10%% of the timed span) or 50 allreduce rounds")
-    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--batch-size", type=int, default=None,
+                    help="per-chip batch; default per-model (MODEL_SPECS)")
     ap.add_argument("--mode", choices=["train", "allreduce"], default="train")
-    ap.add_argument("--scan-steps", type=int, default=400,
+    ap.add_argument("--model", choices=list(MODEL_SPECS), default="mnist_cnn",
+                    help="which BASELINE config to measure (train mode)")
+    ap.add_argument("--scan-steps", type=int, default=None,
                     help="steps fused per dispatch via lax.scan (0 = one "
                          "dispatch per step, the reference's shape — note "
                          "that on a tunneled device that path measures "
@@ -228,9 +307,34 @@ def main(argv=None) -> int:
         # later vs_baseline comparison
         ap.error("--record-baseline requires fp32 (it records the "
                  "reference-semantics baseline)")
+    if args.record_baseline and args.model != "mnist_cnn":
+        # same hazard for the model: the recorded baseline is the MNIST
+        # reference semantics; writing another model's flat keys over it
+        # would silently corrupt every later vs_baseline comparison
+        ap.error("--record-baseline records the MNIST reference baseline; "
+                 "drop --model or use mnist_cnn")
 
-    result = measure(batch_size=args.batch_size, steps=args.steps or 4000,
-                     precision=args.precision, scan_steps=args.scan_steps)
+    spec = MODEL_SPECS[args.model]
+    batch = args.batch_size if args.batch_size is not None else spec["batch"]
+    steps = args.steps or spec["steps"]
+    scan = args.scan_steps if args.scan_steps is not None else spec["scan"]
+
+    if args.model == "bert_base":
+        result = measure_bert(batch_size=batch, steps=steps,
+                              precision=args.precision, scan_steps=scan)
+        print(json.dumps({
+            "metric": "BERT-base MLM train-step throughput "
+                      "(GSPMD, eval off timed path)",
+            "value": round(result["tokens_per_sec_per_chip"], 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,   # no recorded reference-semantics baseline
+            "detail": result,
+        }))
+        return 0
+
+    result = measure(batch_size=batch, steps=steps,
+                     precision=args.precision, scan_steps=scan,
+                     model_name=args.model)
 
     if args.record_baseline:
         _record_baseline("train", result)
@@ -238,7 +342,7 @@ def main(argv=None) -> int:
 
     base = _load_baseline()
     vs = float("nan")
-    if base.get("images_per_sec_per_chip"):
+    if args.model == "mnist_cnn" and base.get("images_per_sec_per_chip"):
         # cross-platform (TPU build vs the CPU reference baseline) is the
         # north-star comparison and always valid.  Within one platform,
         # though, a scan-mode device-throughput number is not comparable to
@@ -249,8 +353,11 @@ def main(argv=None) -> int:
             vs = (result["images_per_sec_per_chip"]
                   / base["images_per_sec_per_chip"])
 
+    names = {"mnist_cnn": "MNIST CNN", "resnet20": "CIFAR ResNet-20",
+             "resnet50": "ImageNet ResNet-50"}
     print(json.dumps({
-        "metric": "MNIST CNN train-step throughput (eval off timed path)",
+        "metric": f"{names[args.model]} train-step throughput "
+                  "(eval off timed path)",
         "value": round(result["images_per_sec_per_chip"], 1),
         "unit": "images/sec/chip",
         "vs_baseline": round(vs, 3) if vs == vs else None,

@@ -174,6 +174,26 @@ def make_gspmd_train_step(model, mesh: Mesh,
     return jax.jit(step, donate_argnums=0, out_shardings=out_shardings)
 
 
+def make_gspmd_multi_step(model, mesh: Mesh,
+                          tx: optax.GradientTransformation,
+                          grad_accum: int = 1):
+    """K GSPMD train steps per dispatch via ``lax.scan`` over stacked
+    batches — the transformer counterpart of train/step.py's
+    ``make_multi_train_step`` (amortizes per-dispatch latency; used by the
+    benchmark harness).  ``batches``/``labels`` carry a leading (K,) axis on
+    every leaf."""
+    one = make_gspmd_train_step(model, mesh, tx, grad_accum=grad_accum)
+
+    def multi(state: GspmdState, batches, labels, rng):
+        def body(s, xs):
+            b, l = xs
+            return one(s, b, l, rng)
+
+        return lax.scan(body, state, (batches, labels))
+
+    return jax.jit(multi, donate_argnums=0)
+
+
 def make_gspmd_eval_step(model, mesh: Mesh):
     """Forward-only logits (eval mode)."""
 
