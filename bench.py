@@ -31,7 +31,7 @@ MODEL_SPECS = {
                      scan=50, steps=500, unit="images"),
     "resnet50": dict(batch=32, shape=(224, 224, 3), classes=1000,
                      scan=8, steps=48, unit="images"),
-    "bert_base": dict(batch=16, seq=128, scan=8, steps=48, unit="tokens"),
+    "bert_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
 }
 
 
@@ -85,9 +85,14 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     toks, tgts, mask = synthetic.mlm_batches(
         K * global_b, seq_len=seq_len, vocab_size=bcfg.vocab_size, seed=0)
     shape = (K, global_b, seq_len)
-    batches = gspmd.shard_batch(
-        {"tokens": toks.reshape(shape), "mask": mask.reshape(shape)}, mesh)
-    labels = gspmd.shard_batch(tgts.reshape(shape), mesh)
+    # leading axis is the scan (step) axis — batch dim 1 shards over 'data'
+    # (gspmd.shard_batch would wrongly map dim 0 to 'data' here)
+    import jax.sharding as shd
+
+    sh = shd.NamedSharding(mesh, shd.PartitionSpec(None, "data"))
+    batches = {"tokens": jax.device_put(toks.reshape(shape), sh),
+               "mask": jax.device_put(mask.reshape(shape), sh)}
+    labels = jax.device_put(tgts.reshape(shape), sh)
 
     sec = _measure_scanned(multi, state, batches, labels, jax.random.key(1),
                            K, max(1, steps // K), warmup_calls=2)
@@ -321,7 +326,8 @@ def main(argv=None) -> int:
 
     if args.model == "bert_base":
         result = measure_bert(batch_size=batch, steps=steps,
-                              precision=args.precision, scan_steps=scan)
+                              precision=args.precision, scan_steps=scan,
+                              seq_len=spec["seq"])
         print(json.dumps({
             "metric": "BERT-base MLM train-step throughput "
                       "(GSPMD, eval off timed path)",

@@ -63,6 +63,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(one dispatch per trace window), 1 for avg50. "
                         "Pass 1 for the reference's one-dispatch-per-step "
                         "shape")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize transformer layers (jax.checkpoint): "
+                        "trade recompute FLOPs for peak activation HBM")
     p.add_argument("--prefetch", choices=["auto", "native", "thread", "off"],
                    default=d.prefetch,
                    help="background window assembly for the fused loop "
@@ -97,7 +100,7 @@ def config_from_args(args) -> Config:
         mesh_shape=parse_mesh(args.mesh),
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         precision=args.precision, grad_accum=args.grad_accum,
-        prefetch=args.prefetch,
+        prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
     )

@@ -52,6 +52,9 @@ class Config:
                                   # defaults to the 50-step trace cadence on
                                   # TPU, where dispatch latency dominates
                                   # tiny steps
+    remat: bool = False           # transformer-layer rematerialization
+                                  # (jax.checkpoint): recompute activations
+                                  # in the backward pass to cut peak HBM
     prefetch: str = "auto"        # window-assembly prefetch for the fused
                                   # loop: "auto" (native C++ worker when
                                   # built, else Python thread), "native",

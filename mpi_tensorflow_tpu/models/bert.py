@@ -48,6 +48,10 @@ class BertConfig:
                                   # "ulysses" (2 all-to-alls, needs heads
                                   # divisible by the seq axis) —
                                   # parallel/ring.py vs parallel/ulysses.py
+    remat: bool = False           # jax.checkpoint each encoder layer:
+                                  # recompute activations in the backward
+                                  # pass — peak activation HBM drops from
+                                  # O(layers) to O(1) residual streams
 
     @property
     def head_dim(self) -> int:
@@ -183,24 +187,29 @@ class BertMlm:
         B, S = tokens.shape
         drop_i = 0
 
-        def dropout(x):
-            nonlocal drop_i
+        def drop_with(i, x):
+            """Dropout keyed by an explicit stream index (stable across a
+            remat recomputation)."""
             if not train or c.dropout == 0.0:
                 return x
             if rng is None:
                 raise ValueError("dropout needs an rng in train mode")
-            drop_i += 1
             keep = 1.0 - c.dropout
             mask = jax.random.bernoulli(
-                jax.random.fold_in(rng, drop_i), keep, x.shape)
+                jax.random.fold_in(rng, i), keep, x.shape)
             return jnp.where(mask, x / keep, 0.0)
+
+        def dropout(x):
+            nonlocal drop_i
+            drop_i += 1
+            return drop_with(drop_i, x)
 
         h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
         h = _layernorm(h, params["emb_ln"])
         h = dropout(h).astype(dt)
         h = self._constrain(h, ("batch", "seq", "embed"))
 
-        for lp in params["layers"]:
+        def layer(h, lp, keys):
             # --- attention (column-parallel QKV, row-parallel out) ---
             q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
                 + lp["bq"].astype(dt)[None, :, None, :]
@@ -214,7 +223,7 @@ class BertMlm:
             a = self._attention(q, k, v)
             a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
                 + lp["bo"].astype(dt)
-            h = _layernorm(h + dropout(a), lp["ln1"]).astype(dt)
+            h = _layernorm(h + drop_with(keys[0], a), lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))
             # --- MLP (column then row parallel) ---
             m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
@@ -222,8 +231,19 @@ class BertMlm:
             m = self._constrain(m, ("batch", "seq", "mlp"))
             m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
                 + lp["b2"].astype(dt)
-            h = _layernorm(h + dropout(m), lp["ln2"]).astype(dt)
-            h = self._constrain(h, ("batch", "seq", "embed"))
+            h = _layernorm(h + drop_with(keys[1], m), lp["ln2"]).astype(dt)
+            return self._constrain(h, ("batch", "seq", "embed"))
+
+        if c.remat:
+            # trade FLOPs for HBM: drop each layer's activations after the
+            # forward pass and recompute them during the backward pass —
+            # peak activation memory goes from O(layers) to O(1) residuals
+            layer = jax.checkpoint(layer)
+        for lp in params["layers"]:
+            # dropout keys derived OUTSIDE the (possibly rematted) layer so
+            # the recomputation replays identical masks
+            drop_i += 2
+            h = layer(h, lp, (drop_i - 1, drop_i))
 
         # --- MLM head: transform + tied decoder ---
         t = jax.nn.gelu(h @ params["mlm"]["w"].astype(dt)

@@ -45,7 +45,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     if bert_cfg is None:
         import dataclasses as dc
 
-        bert_cfg = dc.replace(bert.BERT_BASE, dtype=config.compute_dtype)
+        bert_cfg = dc.replace(bert.BERT_BASE, dtype=config.compute_dtype,
+                              remat=config.remat)
     model = bert.BertMlm(bert_cfg, mesh=mesh)
     tx = optax.adamw(learning_rate)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),

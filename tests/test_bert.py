@@ -139,3 +139,51 @@ class TestGspmdGradAccum:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=5e-4, atol=1e-6),
             s2.params, s1.params)
+
+
+class TestRemat:
+    def test_remat_forward_and_grads_match(self):
+        """jax.checkpoint changes memory, not math: logits and grads must
+        match the plain model exactly (same dropout keys by construction)."""
+        import dataclasses as dc
+
+        cfg_p = dc.replace(bert.BERT_TINY, dropout=0.1)
+        cfg_r = dc.replace(cfg_p, remat=True)
+        m_p, m_r = bert.BertMlm(cfg_p), bert.BertMlm(cfg_r)
+        params = m_p.init(jax.random.key(0))
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg_p.vocab_size, (2, 16)),
+            jnp.int32)
+        key = jax.random.key(7)
+
+        lp = m_p.apply(params, tokens, train=True, rng=key)
+        lr = m_r.apply(params, tokens, train=True, rng=key)
+        np.testing.assert_allclose(np.asarray(lr), np.asarray(lp),
+                                   rtol=1e-6, atol=1e-6)
+
+        def loss(m):
+            def f(p):
+                out = m.apply(p, tokens, train=True, rng=key)
+                return jnp.sum(out ** 2) / out.size
+            return f
+
+        gp = jax.grad(loss(m_p))(params)
+        gr = jax.grad(loss(m_r))(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            gr, gp)
+
+    def test_remat_gspmd_step_runs(self, mesh222):
+        import dataclasses as dc
+
+        model = bert.BertMlm(dc.replace(bert.BERT_TINY, remat=True),
+                             mesh=mesh222)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh222)
+        step = gspmd.make_gspmd_train_step(model, mesh222, tx)
+        batch, targets = mlm_batch(n=4, s=32)
+        state, metrics = step(state, gspmd.shard_batch(batch, mesh222),
+                              gspmd.shard_batch(targets, mesh222),
+                              jax.random.key(1))
+        assert np.isfinite(float(metrics["loss"]))
