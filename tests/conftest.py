@@ -7,16 +7,19 @@ which conftest import-time guarantees under pytest.
 """
 
 import os
+import sys
 
-# Neutralize the axon TPU plugin hook (it keys off this var) and force a
-# virtual 8-device CPU platform so mesh/psum code runs 8-way with no TPU.
-# The env vars alone are not enough: a sitecustomize on this image imports
-# jax at interpreter start, baking the env into jax.config defaults — so we
-# also set the config explicitly before the backend initializes.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ["JAX_NUM_CPU_DEVICES"] = "8"
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+# Neutralize the axon TPU plugin hook and force a virtual 8-device CPU
+# platform so mesh/psum code runs 8-way with no TPU.  The canonical
+# incantation lives in __graft_entry__._force_virtual_cpu_env (shared with
+# the driver dryrun).  The env vars alone are not enough: a sitecustomize on
+# this image imports jax at interpreter start, baking the env into jax.config
+# defaults — so we also set the config explicitly before the backend
+# initializes.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from __graft_entry__ import _force_virtual_cpu_env  # noqa: E402
+
+_force_virtual_cpu_env(os.environ, 8)
 
 import jax  # noqa: E402
 
