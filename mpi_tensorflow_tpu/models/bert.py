@@ -52,6 +52,22 @@ class BertConfig:
                                   # recompute activations in the backward
                                   # pass — peak activation HBM drops from
                                   # O(layers) to O(1) residual streams
+    ce_impl: str = "auto"         # MLM loss: "chunked" = online-logsumexp
+                                  # over vocab tiles, never materializing
+                                  # (B,S,V) fp32 logits (ops/mlm_head.py);
+                                  # "dense" = full logits; "auto" = chunked
+                                  # unless the vocab is tensor-parallel
+                                  # sharded (then GSPMD's sharded dense
+                                  # logits are already memory-bounded)
+    ce_chunk: int = 2048          # vocab tile width for the chunked CE
+    ce_positions: str = "masked"  # "masked": pack each row's masked
+                                  # positions (<= ce_capacity_frac * S of
+                                  # them) before the MLM head, so the head
+                                  # transform + vocab decoder run on ~15-25%
+                                  # of tokens (BERT's
+                                  # max_predictions_per_seq, TPU-shaped);
+                                  # "all": head over every position
+    ce_capacity_frac: float = 0.25  # per-row packed-buffer width / S
 
     @property
     def head_dim(self) -> int:
@@ -178,12 +194,34 @@ class BertMlm:
             return fa.flash_attention(q, k, v)
         return ring.dense_attention(q, k, v)
 
-    def apply(self, params, batch, *, train: bool = False, rng=None):
-        """``batch``: int token ids (B, S) (already masked for MLM).
-        Returns vocab logits (B, S, V)."""
+    def _mlp_block(self, lp, h, idx: int):
+        """Position-wise MLP for layer ``idx`` -> (out, aux_loss).  The
+        dense column/row-parallel MLP; MoE (models/moe.py) overrides this
+        with routed experts on its MoE layers."""
+        dt = self.cfg.dtype
+        m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
+                        + lp["b1"].astype(dt))
+        m = self._constrain(m, ("batch", "seq", "mlp"))
+        m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
+            + lp["b2"].astype(dt)
+        return m, jnp.zeros((), jnp.float32)
+
+    def _aux_weight(self) -> float:
+        """Weight of the auxiliary loss accumulated by ``_mlp_block`` (0 for
+        the dense model; the MoE load-balance weight in models/moe.py)."""
+        return 0.0
+
+    def encode(self, params, tokens, *, train: bool = False, rng=None):
+        """Embeddings + encoder stack.  ``tokens``: int ids (B, S).
+        Returns hidden states (B, S, E) in the compute dtype."""
+        return self._encode_aux(params, tokens, train=train, rng=rng)[0]
+
+    def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
+        """Encoder returning ``(hidden, summed aux loss)``."""
+        import functools
+
         c = self.cfg
         dt = c.dtype
-        tokens = batch
         B, S = tokens.shape
         drop_i = 0
 
@@ -209,7 +247,7 @@ class BertMlm:
         h = dropout(h).astype(dt)
         h = self._constrain(h, ("batch", "seq", "embed"))
 
-        def layer(h, lp, keys):
+        def layer(h, lp, keys, mlp_fn):
             # --- attention (column-parallel QKV, row-parallel out) ---
             q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
                 + lp["bq"].astype(dt)[None, :, None, :]
@@ -225,36 +263,77 @@ class BertMlm:
                 + lp["bo"].astype(dt)
             h = _layernorm(h + drop_with(keys[0], a), lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))
-            # --- MLP (column then row parallel) ---
-            m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
-                            + lp["b1"].astype(dt))
-            m = self._constrain(m, ("batch", "seq", "mlp"))
-            m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
-                + lp["b2"].astype(dt)
+            # --- MLP (dense column/row parallel, or routed experts) ---
+            m, aux = mlp_fn(lp, h)
             h = _layernorm(h + drop_with(keys[1], m), lp["ln2"]).astype(dt)
-            return self._constrain(h, ("batch", "seq", "embed"))
+            return self._constrain(h, ("batch", "seq", "embed")), aux
 
         if c.remat:
             # trade FLOPs for HBM: drop each layer's activations after the
             # forward pass and recompute them during the backward pass —
             # peak activation memory goes from O(layers) to O(1) residuals
-            layer = jax.checkpoint(layer)
-        for lp in params["layers"]:
+            layer = jax.checkpoint(layer, static_argnums=(3,))
+        aux_total = jnp.zeros((), jnp.float32)
+        for i, lp in enumerate(params["layers"]):
             # dropout keys derived OUTSIDE the (possibly rematted) layer so
             # the recomputation replays identical masks
             drop_i += 2
-            h = layer(h, lp, (drop_i - 1, drop_i))
+            h, aux = layer(h, lp, (drop_i - 1, drop_i),
+                           functools.partial(self._mlp_block, idx=i))
+            aux_total = aux_total + aux
+        return h, aux_total
 
-        # --- MLM head: transform + tied decoder ---
+    def head_hidden(self, params, h):
+        """MLM head transform (dense + GELU + LN) — the (B, S, E) input to
+        the tied vocab decoder."""
+        dt = self.cfg.dtype
         t = jax.nn.gelu(h @ params["mlm"]["w"].astype(dt)
                         + params["mlm"]["b"].astype(dt))
-        t = _layernorm(t, params["mlm"]["ln"]).astype(dt)
+        return _layernorm(t, params["mlm"]["ln"]).astype(dt)
+
+    def apply(self, params, batch, *, train: bool = False, rng=None):
+        """``batch``: int token ids (B, S) (already masked for MLM).
+        Returns vocab logits (B, S, V)."""
+        dt = self.cfg.dtype
+        h = self.encode(params, batch, train=train, rng=rng)
+        t = self.head_hidden(params, h)
         logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
             + params["mlm"]["out_b"]
         logits = self._constrain(logits, ("batch", "seq", "vocab"))
         return logits.astype(jnp.float32)
 
     # ---------------- loss ----------------
+
+    def _use_chunked_ce(self) -> bool:
+        if self.cfg.ce_impl == "dense":
+            return False
+        if self.cfg.ce_impl == "chunked":
+            return True
+        # auto: with masked-position packing the logits are (B, S/4, V) —
+        # small enough that XLA's dense path wins; chunking is the rescue
+        # for full-position logits, unless the vocab axis is TP-sharded
+        # (then dense logits are already sharded V/tp per device and GSPMD
+        # places the logsumexp collectives)
+        if self.cfg.ce_positions == "masked":
+            return False
+        return self.mesh is None or self.mesh.shape.get("model", 1) == 1
+
+    def _ce(self, params, t, labels):
+        """Per-position CE (B, S) fp32 from head hidden ``t``."""
+        dt = self.cfg.dtype
+        if self._use_chunked_ce():
+            from mpi_tensorflow_tpu.ops import mlm_head
+
+            return mlm_head.tied_softmax_ce(
+                t, params["tok_emb"], params["mlm"]["out_b"], labels,
+                chunk=self.cfg.ce_chunk)
+        logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
+            + params["mlm"]["out_b"]
+        logits = self._constrain(
+            logits, ("batch", "seq", "vocab")).astype(jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+        return logz - gold
 
     def loss(self, params, model_state, batch, labels, *, rng=None,
              train: bool = False):
@@ -263,13 +342,29 @@ class BertMlm:
         ``batch``: dict with ``tokens`` (B,S) int32 (mask token substituted)
         and ``mask`` (B,S) bool; ``labels``: (B,S) int32 original ids.
         """
-        logits = self.apply(params, batch["tokens"], train=train, rng=rng)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        ce = logz - gold
-        mask = batch["mask"].astype(jnp.float32)
-        loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return loss, model_state
+        h, aux = self._encode_aux(params, batch["tokens"], train=train,
+                                  rng=rng)
+        mask = batch["mask"]
+        if self.cfg.ce_positions == "masked":
+            from mpi_tensorflow_tpu.ops import mlm_head
+
+            S = h.shape[1]
+            cap = min(S, max(8, -(-int(self.cfg.ce_capacity_frac * S) // 8)
+                             * 8))
+            packed, plabels, w = mlm_head.gather_masked_rows(
+                h, labels, mask.astype(jnp.bool_), cap)
+            t = self.head_hidden(params, packed)
+            ce = self._ce(params, t, plabels)
+            weights = w
+        else:
+            t = self.head_hidden(params, h)
+            ce = self._ce(params, t, labels)
+            weights = mask.astype(jnp.float32)
+        # denominator = ALL masked positions (overflow-dropped ones count),
+        # so the two ce_positions modes agree exactly when nothing overflows
+        loss = jnp.sum(ce * weights) \
+            / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return loss + self._aux_weight() * aux, model_state
 
     def l2_params(self, params) -> list:
         return []   # transformer runs use decoupled weight decay (adamw)

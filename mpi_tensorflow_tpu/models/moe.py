@@ -1,41 +1,52 @@
 """Mixture-of-Experts BERT — expert parallelism (EP).
 
 Switch-Transformer-style top-1 routed MoE replacing the dense MLP in every
-other encoder layer.  Expert weight stacks carry a leading ``expert`` logical
-axis sharded over the ``expert`` mesh axis (parallel/sharding_rules.py);
-dispatch/combine are einsums over the expert dimension, so XLA GSPMD lowers
-them to the expert all-to-all exchange.  A load-balancing auxiliary loss
-(Switch Transformer, Fedus et al. 2021) keeps routing uniform.
+other encoder layer.  Routing is *capacity-based*: each expert owns a fixed
+(X, C, E) token buffer with ``C = capacity_factor * tokens / num_experts``;
+tokens are placed by scatter (position-in-expert via a cumulative count) and
+read back by gather, so per-expert compute is ``C`` tokens — the routed MLP
+costs ~``capacity_factor x`` one dense MLP **independent of the number of
+experts** (vs. the dense one-hot dispatch einsum, which pays
+``num_experts x``).  Tokens past capacity are dropped: their MLP output is
+zero and the residual stream carries them through unchanged (Switch
+Transformer, Fedus et al. 2021).
+
+Expert weight stacks carry a leading ``expert`` logical axis sharded over
+the ``expert`` mesh axis (parallel/sharding_rules.py); the scatter/gather
+between token space (sharded over ``data``) and expert space (sharded over
+``expert``) is lowered by XLA GSPMD to the expert all-to-all exchange.  A
+load-balancing auxiliary loss keeps routing uniform.
 
 No counterpart in the reference (SURVEY.md §2 checklist: EP absent); part of
 the framework's full parallelism-strategy coverage (DP/TP/SP/EP + pipeline
-in parallel/pipeline.py).
+in parallel/pipeline.py).  Encoder structure, dropout, MLM head, and loss
+are inherited from models/bert.py — only the MLP block is overridden.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp
 
 from mpi_tensorflow_tpu.models import bert as bert_lib
-from mpi_tensorflow_tpu.models.bert import _layernorm, _norm_init
+from mpi_tensorflow_tpu.models.bert import _norm_init
 
 
 @dataclasses.dataclass(frozen=True)
 class MoeConfig:
-    bert: bert_lib.BertConfig = bert_lib.BERT_TINY
     num_experts: int = 4
+    capacity_factor: float = 1.25  # expert buffer = cf * tokens / experts
     aux_loss_weight: float = 0.01
     every_other: bool = True     # MoE on odd layers, dense MLP on even
 
 
 @dataclasses.dataclass(frozen=True)
 class MoeBertMlm(bert_lib.BertMlm):
-    """BERT-MLM with routed expert MLPs.  Inherits attention/embedding/loss
-    machinery; overrides init/axes/forward for the MoE blocks."""
+    """BERT-MLM with routed expert MLPs.  Inherits the full encoder
+    (attention, dropout, remat), MLM head, and loss; overrides init/axes and
+    the per-layer MLP block."""
     moe: MoeConfig = MoeConfig()
 
     def _is_moe_layer(self, idx: int) -> bool:
@@ -72,82 +83,77 @@ class MoeBertMlm(bert_lib.BertMlm):
             la["eb2"] = ("expert", "embed")
         return axes
 
-    def _moe_mlp(self, h, lp, dt):
-        """Top-1 routed expert MLP.  h: (B, S, E).  Returns (out, aux_loss)."""
-        gate_logits = jnp.einsum("bse,ec->bsc", h, lp["router"].astype(dt))
+    def capacity(self, num_tokens: int) -> int:
+        """Per-expert buffer length: cf * tokens / experts, rounded up to a
+        multiple of 8 (TPU sublane) and at least 8."""
+        import math
+
+        c = math.ceil(self.moe.capacity_factor * num_tokens
+                      / self.moe.num_experts)
+        return max(8, ((c + 7) // 8) * 8)
+
+    def _moe_mlp(self, h, lp):
+        """Capacity-routed top-1 expert MLP.  h: (B, S, E) -> (out, aux)."""
+        dt = self.cfg.dtype
+        X = self.moe.num_experts
+        B, S, E = h.shape
+        N = B * S
+        C = self.capacity(N)
+        hf = h.reshape(N, E)
+
+        # --- route: top-1 expert + position in that expert's buffer ---
+        gate_logits = jnp.einsum("ne,ec->nc", hf, lp["router"].astype(dt))
         gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-        top1 = jnp.argmax(gates, axis=-1)                      # (B, S)
-        ne = self.moe.num_experts
-        dispatch = jax.nn.one_hot(top1, ne, dtype=dt)          # (B, S, X)
-        top_gate = jnp.sum(gates * dispatch.astype(jnp.float32),
-                           axis=-1)                            # (B, S)
-        # dispatch tokens to experts (-> all-to-all under an expert mesh axis)
-        xin = jnp.einsum("bsx,bse->xbse", dispatch, h)
-        a = jax.nn.gelu(jnp.einsum("xbse,xef->xbsf", xin,
-                                   lp["ew1"].astype(dt))
-                        + lp["eb1"].astype(dt)[:, None, None, :])
-        xout = jnp.einsum("xbsf,xfe->xbse", a, lp["ew2"].astype(dt)) \
-            + lp["eb2"].astype(dt)[:, None, None, :]
-        out = jnp.einsum("xbse,bsx->bse", xout, dispatch)
-        out = out * top_gate[..., None].astype(dt)
-        # Switch load-balance loss: ne * sum_x frac_tokens_x * mean_gate_x
-        frac = jnp.mean(dispatch.astype(jnp.float32), axis=(0, 1))
-        mean_gate = jnp.mean(gates, axis=(0, 1))
-        aux = ne * jnp.sum(frac * mean_gate)
+        top1 = jnp.argmax(gates, axis=-1)                       # (N,)
+        top_gate = jnp.take_along_axis(gates, top1[:, None],
+                                       axis=-1)[:, 0]           # (N,)
+        onehot = jax.nn.one_hot(top1, X, dtype=jnp.int32)       # (N, X)
+        # k-th token routed to expert x gets buffer slot k (first-come)
+        pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
+        keep = pos < C                                          # drop overflow
+        # dropped tokens target the sacrificial overflow row X*C
+        slot = jnp.where(keep, top1 * C + pos, X * C)           # (N,)
+
+        # --- dispatch: scatter tokens into the (X, C, E) expert buffers ---
+        buf = jnp.zeros((X * C + 1, E), dt).at[slot].set(hf.astype(dt))
+        xin = buf[:X * C].reshape(X, C, E)
+        xin = self._constrain(xin, ("expert", "capacity", "embed"))
+
+        # --- expert compute: batched matmuls over the expert axis ---
+        a = jax.nn.gelu(jnp.einsum("xce,xef->xcf", xin, lp["ew1"].astype(dt))
+                        + lp["eb1"].astype(dt)[:, None, :])
+        a = self._constrain(a, ("expert", "capacity", "mlp"))
+        xout = jnp.einsum("xcf,xfe->xce", a, lp["ew2"].astype(dt)) \
+            + lp["eb2"].astype(dt)[:, None, :]
+        xout = self._constrain(xout, ("expert", "capacity", "embed"))
+
+        # --- combine: gather each token's expert output (zero if dropped —
+        # the residual connection in the encoder carries it unchanged) ---
+        flat = jnp.concatenate([xout.reshape(X * C, E),
+                                jnp.zeros((1, E), dt)], axis=0)
+        out = flat[slot] * (top_gate * keep)[:, None].astype(dt)
+        out = out.reshape(B, S, E)
+
+        # Switch load-balance loss: X * sum_x frac_tokens_x * mean_gate_x
+        frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        mean_gate = jnp.mean(gates, axis=0)
+        aux = X * jnp.sum(frac * mean_gate)
         return out, aux
 
-    def apply(self, params, batch, *, train: bool = False, rng=None,
-              return_aux: bool = False):
-        c = self.cfg
-        dt = c.dtype
-        tokens = batch
-        B, S = tokens.shape
-        aux_total = 0.0
-        h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
-        h = _layernorm(h, params["emb_ln"]).astype(dt)
-        h = self._constrain(h, ("batch", "seq", "embed"))
+    def _mlp_block(self, lp, h, idx: int):
+        if not self._is_moe_layer(idx):
+            return super()._mlp_block(lp, h, idx)
+        return self._moe_mlp(h, lp)
 
-        for i, lp in enumerate(params["layers"]):
-            q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
-                + lp["bq"].astype(dt)[None, :, None, :]
-            k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
-                + lp["bk"].astype(dt)[None, :, None, :]
-            v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt)) \
-                + lp["bv"].astype(dt)[None, :, None, :]
-            a = self._attention(q, k, v)
-            a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
-                + lp["bo"].astype(dt)
-            h = _layernorm(h + a, lp["ln1"]).astype(dt)
-            h = self._constrain(h, ("batch", "seq", "embed"))
-            if self._is_moe_layer(i):
-                m, aux = self._moe_mlp(h, lp, dt)
-                aux_total = aux_total + aux
-            else:
-                m = jax.nn.gelu(
-                    jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
-                    + lp["b1"].astype(dt))
-                m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
-                    + lp["b2"].astype(dt)
-            h = _layernorm(h + m, lp["ln2"]).astype(dt)
-            h = self._constrain(h, ("batch", "seq", "embed"))
+    def _aux_weight(self) -> float:
+        return self.moe.aux_loss_weight
 
-        t = jax.nn.gelu(h @ params["mlm"]["w"].astype(dt)
-                        + params["mlm"]["b"].astype(dt))
-        t = _layernorm(t, params["mlm"]["ln"]).astype(dt)
+    # kept for callers that want logits + aux in one pass
+    def apply_with_aux(self, params, tokens, *, train: bool = False,
+                       rng=None):
+        dt = self.cfg.dtype
+        h, aux = self._encode_aux(params, tokens, train=train, rng=rng)
+        t = self.head_hidden(params, h)
         logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
             + params["mlm"]["out_b"]
-        logits = logits.astype(jnp.float32)
-        if return_aux:
-            return logits, aux_total
-        return logits
-
-    def loss(self, params, model_state, batch, labels, *, rng=None,
-             train: bool = False):
-        logits, aux = self.apply(params, batch["tokens"], train=train,
-                                 rng=rng, return_aux=True)
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
-        ce = logz - gold
-        mask = batch["mask"].astype(jnp.float32)
-        loss = jnp.sum(ce * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-        return loss + self.moe.aux_loss_weight * aux, model_state
+        return logits.astype(jnp.float32), aux

@@ -1,0 +1,156 @@
+"""Chunked tied-decoder softmax-CE (ops/mlm_head.py): exact equivalence with
+the dense (B, S, V) formulation, in values and in gradients, including a
+vocab size not divisible by the chunk width."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.models import bert
+from mpi_tensorflow_tpu.ops import mlm_head
+
+pytestmark = pytest.mark.quick
+
+
+def _dense_ce(t, emb, out_b, labels):
+    logits = jnp.einsum("bse,ve->bsv", t, emb) + out_b
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, labels[..., None], axis=-1)[..., 0]
+    return logz - gold
+
+
+def _rand(v=1000, b=2, s=16, e=32, seed=0):
+    r = np.random.default_rng(seed)
+    t = jnp.asarray(r.normal(size=(b, s, e)).astype(np.float32))
+    emb = jnp.asarray(r.normal(size=(v, e)).astype(np.float32) * 0.2)
+    out_b = jnp.asarray(r.normal(size=(v,)).astype(np.float32) * 0.1)
+    labels = jnp.asarray(r.integers(0, v, size=(b, s)).astype(np.int32))
+    return t, emb, out_b, labels
+
+
+@pytest.mark.parametrize("v,chunk", [(1024, 256), (1000, 256), (513, 128)])
+def test_ce_matches_dense(v, chunk):
+    t, emb, out_b, labels = _rand(v=v)
+    dense = _dense_ce(t, emb, out_b, labels)
+    chunked = mlm_head.tied_softmax_ce(t, emb, out_b, labels, chunk=chunk)
+    np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ce_grads_match_dense():
+    t, emb, out_b, labels = _rand(v=1000)
+    mask = jnp.asarray(
+        np.random.default_rng(1).random((2, 16)) < 0.3)
+
+    def loss(fn):
+        def f(t, emb, out_b):
+            return mlm_head.masked_mean_ce(fn(t, emb, out_b, labels), mask)
+        return f
+
+    gd = jax.grad(loss(_dense_ce), argnums=(0, 1, 2))(t, emb, out_b)
+    gc = jax.grad(loss(lambda *a: mlm_head.tied_softmax_ce(*a, chunk=256)),
+                  argnums=(0, 1, 2))(t, emb, out_b)
+    for d, c in zip(gd, gc):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(d),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_bert_loss_chunked_matches_dense():
+    """End-to-end: BertMlm.loss with ce_impl=chunked == ce_impl=dense."""
+    import dataclasses
+
+    cfg = dataclasses.replace(bert.BERT_TINY, ce_chunk=192)
+    m_dense = bert.BertMlm(dataclasses.replace(cfg, ce_impl="dense"))
+    m_chunk = bert.BertMlm(dataclasses.replace(cfg, ce_impl="chunked"))
+    params = m_dense.init(jax.random.key(0))
+    r = np.random.default_rng(2)
+    tokens = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    labels = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    batch = {"tokens": tokens, "mask": jnp.asarray(r.random((2, 32)) < 0.2)}
+
+    ld, _ = m_dense.loss(params, None, batch, labels)
+    lc, _ = m_chunk.loss(params, None, batch, labels)
+    np.testing.assert_allclose(float(lc), float(ld), rtol=1e-5)
+
+    gd = jax.grad(lambda p: m_dense.loss(p, None, batch, labels)[0])(params)
+    gc = jax.grad(lambda p: m_chunk.loss(p, None, batch, labels)[0])(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-5), gc, gd)
+
+
+def test_gather_masked_rows_packs_first_come():
+    r = np.random.default_rng(7)
+    B, S, E, P = 3, 16, 4, 8
+    h = jnp.asarray(r.normal(size=(B, S, E)).astype(np.float32))
+    labels = jnp.asarray(r.integers(0, 50, (B, S)), jnp.int32)
+    mask = jnp.asarray(r.random((B, S)) < 0.4)
+    packed, plab, w = mlm_head.gather_masked_rows(h, labels, mask, P)
+    for b in range(B):
+        cols = [s for s in range(S) if bool(mask[b, s])]
+        kept = cols[:P]
+        for j, s in enumerate(kept):
+            assert w[b, j] == 1.0
+            np.testing.assert_array_equal(np.asarray(packed[b, j]),
+                                          np.asarray(h[b, s]))
+            assert int(plab[b, j]) == int(labels[b, s])
+        assert np.all(np.asarray(w[b, len(kept):]) == 0.0)
+
+
+def test_bert_loss_masked_positions_matches_all():
+    """With capacity above the mask count, packed-head loss == full-head
+    loss exactly (same CE, same denominator) — in values and grads."""
+    import dataclasses
+
+    cfg = dataclasses.replace(bert.BERT_TINY, ce_impl="dense")
+    m_all = bert.BertMlm(dataclasses.replace(cfg, ce_positions="all"))
+    m_pack = bert.BertMlm(dataclasses.replace(
+        cfg, ce_positions="masked", ce_capacity_frac=0.5))
+    params = m_all.init(jax.random.key(0))
+    r = np.random.default_rng(4)
+    tokens = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    labels = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    batch = {"tokens": tokens, "mask": jnp.asarray(r.random((2, 32)) < 0.2)}
+    la, _ = m_all.loss(params, None, batch, labels)
+    lp, _ = m_pack.loss(params, None, batch, labels)
+    np.testing.assert_allclose(float(lp), float(la), rtol=1e-6)
+    ga = jax.grad(lambda p: m_all.loss(p, None, batch, labels)[0])(params)
+    gp = jax.grad(lambda p: m_pack.loss(p, None, batch, labels)[0])(params)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(b), np.asarray(a), rtol=2e-5, atol=1e-6), ga, gp)
+
+
+def test_bert_loss_overflow_drops_but_counts():
+    """Overflowed masked positions contribute 0 to the numerator but still
+    count in the denominator (loss <= the all-positions loss is NOT
+    guaranteed per-example, but the weights must sum below the mask)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(bert.BERT_TINY, ce_impl="dense",
+                              ce_positions="masked", ce_capacity_frac=0.25)
+    model = bert.BertMlm(cfg)
+    params = model.init(jax.random.key(0))
+    r = np.random.default_rng(9)
+    tokens = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    labels = jnp.asarray(r.integers(0, cfg.vocab_size, (2, 32)), jnp.int32)
+    # mask everything: 32 masked/row vs capacity 8 -> hard overflow
+    batch = {"tokens": tokens, "mask": jnp.ones((2, 32), bool)}
+    loss, _ = model.loss(params, None, batch, labels)
+    assert np.isfinite(float(loss)) and float(loss) > 0
+
+
+def test_auto_gating():
+    """auto: dense for packed (masked) logits; chunked for full-position
+    logits unless the vocab axis is TP-sharded; explicit settings win."""
+    import dataclasses
+
+    tiny_all = dataclasses.replace(bert.BERT_TINY, ce_positions="all")
+    assert not bert.BertMlm(bert.BERT_TINY)._use_chunked_ce()  # masked
+    assert bert.BertMlm(tiny_all)._use_chunked_ce()
+    mesh1 = jax.make_mesh((8, 1), ("data", "model"))
+    mesh2 = jax.make_mesh((4, 2), ("data", "model"))
+    assert bert.BertMlm(tiny_all, mesh=mesh1)._use_chunked_ce()
+    assert not bert.BertMlm(tiny_all, mesh=mesh2)._use_chunked_ce()
+    forced = dataclasses.replace(bert.BERT_TINY, ce_impl="chunked")
+    assert bert.BertMlm(forced, mesh=mesh2)._use_chunked_ce()
