@@ -32,6 +32,7 @@ MODEL_SPECS = {
     "resnet50": dict(batch=32, shape=(224, 224, 3), classes=1000,
                      scan=8, steps=48, unit="images"),
     "bert_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
+    "moe_bert": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
 }
 
 
@@ -56,9 +57,13 @@ def _measure_scanned(multi_step, state, batches, labels, key, scan_steps,
 
 
 def measure_bert(batch_size: int, steps: int, precision: str,
-                 scan_steps: int, seq_len: int = 128) -> dict:
+                 scan_steps: int, seq_len: int = 128,
+                 ce_impl: str = "auto", ce_chunk: int = 2048,
+                 model_name: str = "bert_base", remat: bool = False) -> dict:
     """BERT-base MLM train-step throughput (BASELINE config 5) via the
-    GSPMD path — adamw, tied-decoder MLM loss, scanned dispatches."""
+    GSPMD path — adamw, tied-decoder MLM loss, scanned dispatches.
+    ``model_name="moe_bert"`` swaps in the capacity-routed MoE variant
+    (BERT-base geometry, experts on odd layers)."""
     import dataclasses as dc
 
     import jax
@@ -75,8 +80,14 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     mesh = meshlib.make_mesh()
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
-    bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype)
-    model = bert.BertMlm(bcfg, mesh=mesh)
+    bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype,
+                      ce_impl=ce_impl, ce_chunk=ce_chunk, remat=remat)
+    if model_name == "moe_bert":
+        from mpi_tensorflow_tpu.models import moe
+
+        model = moe.MoeBertMlm(bcfg, mesh=mesh)
+    else:
+        model = bert.BertMlm(bcfg, mesh=mesh)
     tx = optax.adamw(1e-4)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
     multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
@@ -97,7 +108,7 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     sec = _measure_scanned(multi, state, batches, labels, jax.random.key(1),
                            K, max(1, steps // K), warmup_calls=2)
     return {
-        "model": "bert_base",
+        "model": model_name,
         "tokens_per_sec_per_chip": batch_size * seq_len / sec,
         "examples_per_sec_per_chip": batch_size / sec,
         "step_time_ms": sec * 1e3,
@@ -106,13 +117,15 @@ def measure_bert(batch_size: int, steps: int, precision: str,
         "seq_len": seq_len,
         "precision": precision,
         "scan_steps": K,
+        "ce_impl": ce_impl,
+        "ce_chunk": ce_chunk,
         "platform": jax.devices()[0].platform,
     }
 
 
 def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
             precision: str = "fp32", scan_steps: int = 50,
-            model_name: str = "mnist_cnn") -> dict:
+            model_name: str = "mnist_cnn", remat: bool = False) -> dict:
     """Train-step throughput for the image families.
 
     ``scan_steps > 0`` stages K batches on device and runs K steps per
@@ -134,7 +147,7 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     in_shape = spec["shape"]
     cfg = Config(batch_size=batch_size, precision=precision,
                  model=model_name, num_classes=spec["classes"],
-                 image_size=in_shape[0])
+                 image_size=in_shape[0], remat=remat)
     mesh = meshlib.make_mesh()
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
@@ -276,6 +289,16 @@ def main(argv=None) -> int:
                          "that on a tunneled device that path measures "
                          "dispatch pipelining, not device compute)")
     ap.add_argument("--payload-mb", type=float, default=25.4)
+    ap.add_argument("--ce", choices=["auto", "dense", "chunked"],
+                    default="auto",
+                    help="BERT MLM loss implementation (models/bert.py "
+                         "ce_impl): chunked = online-logsumexp vocab tiles, "
+                         "never materializing (B,S,V) fp32 logits")
+    ap.add_argument("--ce-chunk", type=int, default=2048,
+                    help="vocab tile width for --ce chunked")
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize residual blocks / encoder layers "
+                         "(frees HBM for larger batches)")
     ap.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
                     help="compute dtype for the timed train step. fp32 is "
                          "the like-for-like reference comparison AND the "
@@ -324,12 +347,16 @@ def main(argv=None) -> int:
     steps = args.steps or spec["steps"]
     scan = args.scan_steps if args.scan_steps is not None else spec["scan"]
 
-    if args.model == "bert_base":
+    if args.model in ("bert_base", "moe_bert"):
         result = measure_bert(batch_size=batch, steps=steps,
                               precision=args.precision, scan_steps=scan,
-                              seq_len=spec["seq"])
+                              seq_len=spec["seq"], ce_impl=args.ce,
+                              ce_chunk=args.ce_chunk, model_name=args.model,
+                              remat=args.remat)
+        label = ("MoE-BERT (capacity-routed EP)" if args.model == "moe_bert"
+                 else "BERT-base")
         print(json.dumps({
-            "metric": "BERT-base MLM train-step throughput "
+            "metric": f"{label} MLM train-step throughput "
                       "(GSPMD, eval off timed path)",
             "value": round(result["tokens_per_sec_per_chip"], 1),
             "unit": "tokens/sec/chip",
@@ -340,7 +367,7 @@ def main(argv=None) -> int:
 
     result = measure(batch_size=batch, steps=steps,
                      precision=args.precision, scan_steps=scan,
-                     model_name=args.model)
+                     model_name=args.model, remat=args.remat)
 
     if args.record_baseline:
         _record_baseline("train", result)

@@ -44,7 +44,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--data-dir", default=d.data_dir)
     p.add_argument("--model", default=d.model,
-                   choices=["mnist_cnn", "resnet20", "resnet50", "bert_base"])
+                   choices=["mnist_cnn", "resnet20", "resnet50", "bert_base",
+                            "moe_bert"])
     p.add_argument("--dataset", default=d.dataset,
                    choices=["mnist", "cifar10", "imagenet_synthetic",
                             "mlm_synthetic"])
@@ -117,7 +118,7 @@ def main(argv=None) -> int:
     from mpi_tensorflow_tpu.utils import profiling
 
     with profiling.trace(args.profile_dir):
-        if config.model == "bert_base":
+        if config.model in ("bert_base", "moe_bert"):
             from mpi_tensorflow_tpu.train import mlm_loop
 
             mlm_loop.train_mlm(config)

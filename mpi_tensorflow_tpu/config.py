@@ -88,7 +88,7 @@ class Config:
     dropout_rate: float = 0.5     # mpipy.py:166
     data_dir: str = "./data"      # mpipy.py:187
     model: str = "mnist_cnn"      # flagship families: mnist_cnn, resnet20,
-                                  # resnet50, bert_base
+                                  # resnet50, bert_base, moe_bert
     dataset: str = "mnist"
 
     @property

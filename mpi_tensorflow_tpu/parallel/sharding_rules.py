@@ -32,7 +32,9 @@ DEFAULT_RULES: dict[str, Optional[str]] = {
     "classes": None,
     "expert": "expert",  # MoE expert stacks expert-parallel (models/moe.py)
     "expert_classes": None,   # router output dim (small) replicated
+    "capacity": None,    # per-expert token buffer dim (models/moe.py)
     "stage": "pipe",     # pipeline-stage stacks (parallel/pipeline.py)
+    "layer": None,       # within-stage layer dim (models/bert_pipeline.py)
 }
 
 

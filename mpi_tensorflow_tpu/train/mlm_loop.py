@@ -47,7 +47,23 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
         bert_cfg = dc.replace(bert.BERT_BASE, dtype=config.compute_dtype,
                               remat=config.remat)
-    model = bert.BertMlm(bert_cfg, mesh=mesh)
+    if config.model == "moe_bert":
+        from mpi_tensorflow_tpu.models import moe
+
+        model = moe.MoeBertMlm(bert_cfg, mesh=mesh)
+    elif mesh.shape.get("pipe", 1) > 1:
+        import dataclasses as dc
+
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        if bert_cfg.dropout:
+            if verbose:
+                print("[pipeline] dropout disabled (not yet supported "
+                      "through the pipe schedule)")
+            bert_cfg = dc.replace(bert_cfg, dropout=0.0)
+        model = bert_pipeline.PipelinedBertMlm(bert_cfg, mesh=mesh)
+    else:
+        model = bert.BertMlm(bert_cfg, mesh=mesh)
     tx = optax.adamw(learning_rate)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
                                    mesh)

@@ -48,6 +48,92 @@ class TestMoe:
         assert all(np.isfinite(l) for l in losses)
         assert losses[-1] < losses[0] - 0.5, losses
 
+    def test_capacity_dispatch_matches_naive(self):
+        """Scatter/gather dispatch == a per-token python loop: top-1 expert,
+        first-come capacity, gate-scaled output, dropped tokens -> zero."""
+        cfg = bert.BERT_TINY
+        model = moe.MoeBertMlm(
+            cfg, moe=moe.MoeConfig(num_experts=4, capacity_factor=0.5))
+        params = model.init(jax.random.key(0))
+        lp = params["layers"][1]
+        rng = np.random.default_rng(3)
+        B, S, E = 4, 32, cfg.hidden
+        h = jnp.asarray(rng.normal(size=(B, S, E)).astype(np.float32))
+        out, aux = model._moe_mlp(h, lp)
+
+        N = B * S
+        C = model.capacity(N)
+        assert C < N // 4, "capacity must actually drop tokens in this test"
+        hf = np.asarray(h).reshape(N, E)
+        gates = np.asarray(jax.nn.softmax(
+            jnp.asarray(hf) @ lp["router"], axis=-1))
+        top1 = gates.argmax(-1)
+        want = np.zeros((N, E), np.float32)
+        counts = np.zeros(4, np.int64)
+        dropped = 0
+        for n in range(N):
+            x = int(top1[n])
+            if counts[x] >= C:
+                dropped += 1
+                continue
+            counts[x] += 1
+            a = np.asarray(jax.nn.gelu(
+                jnp.asarray(hf[n]) @ lp["ew1"][x] + lp["eb1"][x]))
+            o = np.asarray(jnp.asarray(a) @ lp["ew2"][x] + lp["eb2"][x])
+            want[n] = o * gates[n, x]
+        assert dropped > 0, "test must exercise the overflow path"
+        np.testing.assert_allclose(np.asarray(out).reshape(N, E), want,
+                                   rtol=2e-4, atol=2e-5)
+        assert np.isfinite(float(aux))
+
+    def test_per_expert_flops_independent_of_expert_count(self):
+        """The routed MLP's compiled FLOPs must not scale with num_experts
+        (capacity shrinks as experts grow) — the point of real EP dispatch."""
+        cfg = bert.BERT_TINY
+        rng = np.random.default_rng(0)
+        h = jnp.asarray(rng.normal(size=(4, 64, cfg.hidden))
+                        .astype(np.float32))
+
+        def flops(X):
+            model = moe.MoeBertMlm(
+                cfg, moe=moe.MoeConfig(num_experts=X, capacity_factor=1.0))
+            params = model.init(jax.random.key(0))
+            lp = params["layers"][1]
+            f = jax.jit(lambda hh: model._moe_mlp(hh, lp)[0])
+            cost = f.lower(h).compile().cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0]
+            return (cost or {}).get("flops")
+
+        f2, f8 = flops(2), flops(8)
+        if not f2 or not f8:
+            pytest.skip("cost_analysis unavailable on this backend")
+        # 4x the experts must NOT mean ~4x the FLOPs; allow routing overhead
+        assert f8 < 2.0 * f2, (f2, f8)
+
+    def test_moe_layers_apply_dropout(self):
+        """The MoE encoder inherits dropout (round-1 gap: it was silently
+        dropped)."""
+        import dataclasses as dc
+
+        cfg = dc.replace(bert.BERT_TINY, dropout=0.3)
+        model = moe.MoeBertMlm(cfg, moe=moe.MoeConfig(num_experts=2))
+        params = model.init(jax.random.key(0))
+        rng = np.random.default_rng(5)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)),
+                             jnp.int32)
+        batch = {"tokens": tokens,
+                 "mask": jnp.asarray(rng.random((2, 16)) < 0.3)}
+        labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)),
+                             jnp.int32)
+        l_eval, _ = model.loss(params, None, batch, labels, train=False)
+        l_tr1, _ = model.loss(params, None, batch, labels, train=True,
+                              rng=jax.random.key(1))
+        l_tr2, _ = model.loss(params, None, batch, labels, train=True,
+                              rng=jax.random.key(2))
+        assert float(l_tr1) != float(l_eval)
+        assert float(l_tr1) != float(l_tr2)
+
     def test_routing_is_selective(self):
         """Different tokens must reach different experts (not all one)."""
         model = moe.MoeBertMlm(bert.BERT_TINY,
@@ -59,6 +145,79 @@ class TestMoe:
             "bse,ec->bsc", h, params["layers"][1]["router"])
         top1 = np.asarray(jnp.argmax(gate_logits, -1))
         assert len(np.unique(top1)) > 1
+
+
+class TestPipelinedBert:
+    """The generic GPipe schedule driving the real model: loss, backward,
+    and optimizer all flow through the pipeline (round-1 gap: only toy
+    stage fns were ever pipelined)."""
+
+    @pytest.fixture(scope="class")
+    def mesh_pd(self):
+        return meshlib.make_mesh({"pipe": 4, "data": 2})
+
+    def _batch(self, cfg, n=8, seq=16, seed=0):
+        tokens, targets, mask = synthetic.mlm_batches(
+            n, seq_len=seq, vocab_size=cfg.vocab_size, seed=seed)
+        return {"tokens": tokens, "mask": mask}, targets
+
+    def test_pipelined_loss_matches_plain_bert(self, mesh_pd):
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.0)
+        plain = bert.BertMlm(cfg)
+        params = plain.init(jax.random.key(0))
+        piped = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh_pd,
+                                               num_microbatches=2)
+        pparams = dict(params)
+        pparams["layers"] = bert_pipeline.stack_layers(params["layers"], 4)
+        pparams = sharding_rules.shard_tree(
+            pparams, piped.logical_axes(), mesh_pd)
+
+        batch, targets = self._batch(cfg)
+        l_plain, _ = plain.loss(params, None, batch, targets)
+        l_pipe, _ = piped.loss(pparams, None, batch, targets)
+        np.testing.assert_allclose(float(l_pipe), float(l_plain),
+                                   rtol=2e-5)
+
+        g_plain = jax.grad(
+            lambda p: plain.loss(p, None, batch, targets)[0])(params)
+        g_pipe = jax.grad(
+            lambda p: piped.loss(p, None, batch, targets)[0])(pparams)
+        # compare the stage-stacked layer grads against restacked plain ones
+        want = bert_pipeline.stack_layers(g_plain["layers"], 4)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            g_pipe["layers"], want)
+        np.testing.assert_allclose(
+            np.asarray(g_pipe["tok_emb"]), np.asarray(g_plain["tok_emb"]),
+            rtol=1e-4, atol=1e-5)
+
+    def test_full_train_step_through_pipeline(self, mesh_pd):
+        """GSPMD train step (loss+backward+adamw) over pipe x data: loss
+        decreases and stage params stay pipe-sharded."""
+        from jax.sharding import PartitionSpec
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.0)
+        model = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh_pd,
+                                               num_microbatches=2)
+        tx = optax.adamw(2e-3)
+        state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh_pd)
+        assert state.params["layers"]["wq"].sharding.spec[0] == "pipe"
+        step = gspmd.make_gspmd_train_step(model, mesh_pd, tx)
+        batch, targets = self._batch(cfg)
+        batch = gspmd.shard_batch(batch, mesh_pd)
+        targets = gspmd.shard_batch(targets, mesh_pd)
+        losses = []
+        for _ in range(8):
+            state, m = step(state, batch, targets, jax.random.key(1))
+            losses.append(float(m["loss"]))
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0] - 0.5, losses
+        assert state.params["layers"]["wq"].sharding.spec[0] == "pipe"
 
 
 class TestPipeline:
