@@ -1,0 +1,148 @@
+"""Pipeline-parallel BERT-MLM: the encoder stack as GPipe stages.
+
+``PipelinedBertMlm`` is the real-model counterpart of the generic schedule
+in parallel/pipeline.py (which round 1 only exercised with toy stage fns):
+the L encoder layers are split into ``pipe`` stages of L/P layers whose
+parameters carry a leading ``stage`` logical axis sharded over the ``pipe``
+mesh axis.  Embeddings and the MLM head stay replicated outside the
+pipeline (they are ~1% of encoder FLOPs at BERT-base geometry).  The full
+*training* step — loss, backward, optimizer — runs through the schedule:
+``train/gspmd.make_gspmd_train_step`` works unchanged because this is just
+a ``BertMlm`` whose encoder calls ``parallel.pipeline.pipeline`` inside a
+``shard_map``; reverse-mode autodiff of the scanned schedule yields the
+backward pipeline (reverse ``ppermute`` hops) automatically.
+
+Composition: ``pipe x data`` (each data shard runs its own microbatch
+stream through the stages).  TP/SP inside a stage and the 1F1B schedule are
+future work; the loss-side machinery (masked-position packing, chunked CE)
+is inherited.
+
+No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mpi_tensorflow_tpu.models import bert as bert_lib
+from mpi_tensorflow_tpu.models.bert import _layernorm
+from mpi_tensorflow_tpu.parallel import pipeline as pipeline_lib
+from mpi_tensorflow_tpu.parallel import ring
+
+
+def stack_layers(layers: list, num_stages: int):
+    """List of L per-layer param dicts -> stacked pytree of
+    (num_stages, L/num_stages, ...) arrays (stage-major, layer order
+    preserved)."""
+    L = len(layers)
+    if L % num_stages:
+        raise ValueError(f"{L} layers not divisible by {num_stages} stages")
+    return jax.tree.map(
+        lambda *xs: jnp.stack(xs).reshape(
+            (num_stages, L // num_stages) + xs[0].shape), *layers)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedBertMlm(bert_lib.BertMlm):
+    """BERT-MLM with the encoder pipelined over the mesh's ``pipe`` axis."""
+    num_microbatches: int = 4
+
+    @property
+    def _num_stages(self) -> int:
+        return self.mesh.shape.get("pipe", 1) if self.mesh is not None else 1
+
+    def init(self, rng):
+        params = super().init(rng)
+        params["layers"] = stack_layers(params["layers"], self._num_stages)
+        return params
+
+    def logical_axes(self):
+        axes = super().logical_axes()
+        layer0 = axes["layers"][0]
+        axes["layers"] = {k: ("stage", "layer") + v
+                          for k, v in layer0.items()
+                          if not isinstance(v, dict)}
+        for k, v in layer0.items():
+            if isinstance(v, dict):   # layernorm sub-dicts
+                axes["layers"][k] = {kk: ("stage", "layer") + vv
+                                     for kk, vv in v.items()}
+        return axes
+
+    def _plain_layer(self, lp, h):
+        """One encoder layer with no mesh constraints — runs inside the
+        pipe ``shard_map`` where GSPMD annotations are unavailable.  Same
+        math as BertMlm's layer (dropout-free; see ``_encode_aux``)."""
+        dt = self.cfg.dtype
+        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
+            + lp["bq"].astype(dt)[None, :, None, :]
+        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
+            + lp["bk"].astype(dt)[None, :, None, :]
+        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt)) \
+            + lp["bv"].astype(dt)[None, :, None, :]
+        a = ring.dense_attention(q, k, v)
+        a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
+            + lp["bo"].astype(dt)
+        h = _layernorm(h + a, lp["ln1"]).astype(dt)
+        m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
+                        + lp["b1"].astype(dt))
+        m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
+            + lp["b2"].astype(dt)
+        return _layernorm(h + m, lp["ln2"]).astype(dt)
+
+    def _stage(self, stage_params, x):
+        """Run this stage's L/P layers sequentially (scan over the layer
+        dim of the stacked params)."""
+        def body(h, lp):
+            return self._plain_layer(lp, h), None
+
+        h, _ = lax.scan(body, x, stage_params)
+        return h
+
+    def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
+        c = self.cfg
+        if train and c.dropout > 0.0:
+            raise NotImplementedError(
+                "PipelinedBertMlm does not support dropout yet — set "
+                "dropout=0.0 in the BertConfig")
+        dt = c.dtype
+        B, S = tokens.shape
+        h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
+        h = _layernorm(h, params["emb_ln"]).astype(dt)
+        h = self._constrain(h, ("batch", "seq", "embed"))
+
+        n_stages = self._num_stages
+        if n_stages == 1:   # no pipe axis: plain sequential stack
+            def body(hh, lp):
+                return self._plain_layer(lp, hh), None
+
+            flat = jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), params["layers"])
+            h, _ = lax.scan(body, h, flat)
+            return h, jnp.zeros((), jnp.float32)
+
+        M = self.num_microbatches
+        dp = self.mesh.shape.get("data", 1)
+        if (B // dp) % M:
+            raise ValueError(
+                f"per-data-shard batch {B // dp} not divisible by "
+                f"{M} microbatches")
+        h_spec = P("data" if dp > 1 else None)
+
+        def inner(stacked_local, hl):
+            stage_params = jax.tree.map(lambda x: x[0], stacked_local)
+            mb = hl.reshape((M, hl.shape[0] // M) + hl.shape[1:])
+            out = pipeline_lib.pipeline(
+                lambda p, x: self._stage(p, x), stage_params, mb, "pipe")
+            return out.reshape(hl.shape)
+
+        h = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(P("pipe"), h_spec), out_specs=h_spec,
+            check_vma=False)(params["layers"], h)
+        h = self._constrain(h, ("batch", "seq", "embed"))
+        return h, jnp.zeros((), jnp.float32)
