@@ -1,23 +1,56 @@
 """Checkpoint / resume.
 
 The reference persists nothing — no ``tf.train.Saver``, any failure loses the
-run (SURVEY.md §5 checkpoint row).  Here any train-state pytree
-(``TrainState`` or ``GspmdState``) round-trips through a numpy ``.npz``
-archive plus a JSON sidecar of metadata; restore takes a template state (from
-``init_state``) so no code objects are ever pickled.  Device placement /
-shardings are re-applied by ``device_put``-ing restored leaves onto the
-template leaves' shardings, so a checkpoint written on one mesh restores
-onto another (e.g. 8-chip run resumed on 16 chips).
+run (SURVEY.md §5 checkpoint row).  Two formats:
+
+- ``save``/``restore``: whole-state numpy ``.npz`` + JSON sidecar.  Simple,
+  but gathers every leaf to one host — fine for the small image models.
+- ``save_sharded``/``restore_sharded``: pod-scale layout.  Each process
+  writes only the *addressable* shards it owns (one ``.npy`` per distinct
+  shard region, replica-deduplicated), so an FSDP-sharded state is never
+  materialized on any single host.  Restore reads shard files through
+  ``np.load(mmap_mode="r")`` inside ``jax.make_array_from_callback`` — each
+  device pulls exactly the slice it needs, so restoring onto a *different*
+  mesh shape (8-chip run resumed on 16 chips, FSDP included) re-shards
+  without a full-host copy.  A shared filesystem is assumed across hosts
+  (the standard pod setup).
+
+``AsyncSaver`` takes either format off the training loop's critical path:
+the device->host snapshot of addressable shards is synchronous (the loop may
+donate the buffers immediately after), the disk write happens on a worker
+thread.  Restore takes a template state (from ``init_state``) so no code
+objects are ever pickled.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import queue
+import threading
 from typing import Any, Optional
 
 import jax
 import numpy as np
+
+
+def _snapshot_npz(state: Any, step: Optional[int],
+                  extra: Optional[dict]) -> tuple[dict, dict]:
+    """Host copies of every leaf + metadata — the single definition of the
+    npz checkpoint format (shared by ``save`` and ``AsyncSaver``)."""
+    leaves = jax.tree.leaves(state)
+    arrays = {f"leaf_{i:05d}": np.asarray(x) for i, x in enumerate(leaves)}
+    meta = {"num_leaves": len(leaves), "step": step, "extra": extra or {}}
+    return arrays, meta
+
+
+def _write_npz(path: str, arrays: dict, meta: dict) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path + ".npz")
+    with open(path + ".json", "w") as f:
+        json.dump(meta, f)
 
 
 def save(path: str, state: Any, *, step: Optional[int] = None,
@@ -27,15 +60,8 @@ def save(path: str, state: Any, *, step: Optional[int] = None,
     Multi-host: call on process 0 only (params are replicated or
     addressable-shard gathers are the caller's policy).
     """
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    leaves = jax.tree.leaves(state)
-    arrays = {f"leaf_{i:05d}": np.asarray(x) for i, x in enumerate(leaves)}
-    tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path + ".npz")
-    meta = {"num_leaves": len(leaves), "step": step, "extra": extra or {}}
-    with open(path + ".json", "w") as f:
-        json.dump(meta, f)
+    arrays, meta = _snapshot_npz(state, step, extra)
+    _write_npz(path, arrays, meta)
 
 
 def restore(path: str, template: Any) -> tuple[Any, dict]:
@@ -73,18 +99,247 @@ def restore(path: str, template: Any) -> tuple[Any, dict]:
     return jax.tree.unflatten(treedef, placed), meta
 
 
+# ---------------------------------------------------------------------------
+# sharded (pod-scale) format
+# ---------------------------------------------------------------------------
+
+def _shard_regions(x) -> list[tuple[tuple, Any]]:
+    """Distinct shard regions of ``x`` as ``(index, canonical_device)`` —
+    one entry per unique slice tuple, owned by the lowest-id device holding
+    it (replica dedup)."""
+    if not hasattr(x, "sharding"):
+        return [(tuple(slice(None) for _ in np.shape(x)), None)]
+    imap = x.sharding.devices_indices_map(np.shape(x))
+    canon: dict = {}
+    for dev, idx in imap.items():
+        key = tuple((s.start, s.stop) for s in idx)
+        if key not in canon or dev.id < canon[key][1].id:
+            canon[key] = (idx, dev)
+    return [(idx, dev) for idx, dev in canon.values()]
+
+
+def _region_meta(idx, shape) -> dict:
+    start = [s.start or 0 for s in idx]
+    stop = [s.stop if s.stop is not None else dim
+            for s, dim in zip(idx, shape)]
+    return {"start": start, "stop": stop}
+
+
+def snapshot_sharded(state: Any) -> tuple[list, dict]:
+    """Device->host copy of this process's canonical addressable shards.
+
+    Returns ``(jobs, meta)``: jobs are ``(filename, np.ndarray)`` pairs to
+    write; meta describes every leaf's global shape/dtype and shard layout
+    (identical on every process — shardings are global knowledge).  This is
+    the only part of a save that must happen before buffers are donated.
+    """
+    leaves = jax.tree.leaves(state)
+    jobs, leaf_meta = [], []
+    for i, x in enumerate(leaves):
+        shape = tuple(np.shape(x))
+        regions = _shard_regions(x)
+        shards = []
+        local = {}
+        if hasattr(x, "addressable_shards"):
+            for sh in x.addressable_shards:
+                key = tuple((s.start, s.stop) for s in sh.index)
+                # replicated regions appear once per device — keep the
+                # lowest-id one to mirror the canonical-owner choice
+                if key not in local or sh.device.id < local[key].device.id:
+                    local[key] = sh
+        for j, (idx, dev) in enumerate(sorted(
+                regions, key=lambda r: _region_meta(r[0], shape)["start"])):
+            fname = f"l{i:05d}_s{j:04d}.npy"
+            m = _region_meta(idx, shape)
+            m["file"] = fname
+            shards.append(m)
+            key = tuple((s.start, s.stop) for s in idx)
+            if dev is None:
+                jobs.append((fname, np.asarray(x)))
+            elif key in local and local[key].device == dev:
+                jobs.append((fname, np.asarray(local[key].data)))
+        dtype = np.dtype(getattr(x, "dtype", np.asarray(x).dtype))
+        leaf_meta.append({"shape": list(shape), "dtype": dtype.str,
+                          "shards": shards})
+    return jobs, {"num_leaves": len(leaves), "leaves": leaf_meta}
+
+
+def save_sharded(path: str, state: Any, *, step: Optional[int] = None,
+                 extra: Optional[dict] = None) -> None:
+    """Write ``state`` to ``<path>.sharded/`` — every process calls this;
+    each writes only its own shard files, process 0 writes the metadata."""
+    jobs, meta = snapshot_sharded(state)
+    meta.update(step=step, extra=extra or {})
+    _write_sharded(path, jobs, meta)
+
+
+def _write_sharded(path: str, jobs: list, meta: dict) -> None:
+    # all processes write shard files into the final directory; process 0
+    # writes meta.json last — its presence is the commit marker (latest_step
+    # ignores directories without it)
+    d = path + ".sharded"
+    os.makedirs(d, exist_ok=True)
+    for fname, arr in jobs:
+        tmpf = os.path.join(d, fname + ".tmp")
+        with open(tmpf, "wb") as f:
+            np.save(f, arr)
+        os.replace(tmpf, os.path.join(d, fname))
+    if jax.process_count() > 1:
+        # the commit marker must not be written until EVERY host's shard
+        # files are durable — otherwise a preemption between process 0's
+        # meta write and a straggler's shard write leaves a checkpoint that
+        # latest_step() reports committed but restore cannot read
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("ckpt_shards_written")
+    if jax.process_index() == 0:
+        tmpm = os.path.join(d, "meta.json.tmp")
+        with open(tmpm, "w") as f:
+            json.dump(meta, f)
+        os.replace(tmpm, os.path.join(d, "meta.json"))
+
+
+def restore_sharded(path: str, template: Any) -> tuple[Any, dict]:
+    """Load ``<path>.sharded/`` into the structure + shardings of
+    ``template``.  Each device reads exactly its slice (mmap-backed), so a
+    state saved on one mesh restores onto another — FSDP included — without
+    materializing any full leaf on a host."""
+    d = path + ".sharded"
+    with open(os.path.join(d, "meta.json")) as f:
+        meta = json.load(f)
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(t_leaves) != meta["num_leaves"]:
+        raise ValueError(
+            f"checkpoint has {meta['num_leaves']} leaves, template has "
+            f"{len(t_leaves)} — model/config mismatch")
+    import jax.numpy as jnp
+
+    placed = []
+    for lm, want in zip(meta["leaves"], t_leaves):
+        shape = tuple(lm["shape"])
+        if shape != tuple(np.shape(want)):
+            raise ValueError(
+                f"leaf shape mismatch: checkpoint {shape} vs template "
+                f"{np.shape(want)}")
+        dtype = np.dtype(getattr(want, "dtype", np.dtype(lm["dtype"])))
+        files = [(tuple(s["start"]), tuple(s["stop"]),
+                  os.path.join(d, s["file"])) for s in lm["shards"]]
+
+        def read_slice(index, files=files, shape=shape, dtype=dtype):
+            # absolute hyperrectangle requested by one device
+            req = [(s.start or 0, s.stop if s.stop is not None else dim)
+                   for s, dim in zip(index, shape)]
+            out = np.empty([hi - lo for lo, hi in req], dtype)
+            for start, stop, fname in files:
+                inter = [(max(lo, a), min(hi, b))
+                         for (lo, hi), (a, b) in zip(req, zip(start, stop))]
+                if any(lo >= hi for lo, hi in inter):
+                    continue
+                src = np.load(fname, mmap_mode="r")
+                src_sl = tuple(slice(lo - a, hi - a) for (lo, hi), a
+                               in zip(inter, start))
+                dst_sl = tuple(slice(lo - r0, hi - r0) for (lo, hi), (r0, _)
+                               in zip(inter, req))
+                out[dst_sl] = src[src_sl]
+            return out.astype(dtype)
+
+        sharding = getattr(want, "sharding", None)
+        if sharding is not None and hasattr(sharding, "mesh"):
+            placed.append(jax.make_array_from_callback(
+                shape, sharding, read_slice))
+        else:
+            full = read_slice(tuple(slice(None) for _ in shape))
+            placed.append(jnp.asarray(full))
+    return jax.tree.unflatten(treedef, placed), meta
+
+
+class AsyncSaver:
+    """Background checkpoint writer: ``save()`` snapshots the state's
+    addressable shards to host (synchronous — safe against buffer donation)
+    and hands the disk write to a worker thread.  At most one write is in
+    flight; a second ``save`` waits for the first (bounded memory).  Worker
+    errors re-raise on the next ``save``/``wait``."""
+
+    def __init__(self):
+        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._work, daemon=True)
+        self._thread.start()
+
+    def _work(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                kind, path, payload, meta = job
+                if kind == "sharded":
+                    _write_sharded(path, payload, meta)
+                else:
+                    _write_npz(path, payload, meta)
+            except BaseException as e:
+                self._exc = e
+            finally:
+                self._q.task_done()
+
+    def _check(self):
+        if self._exc is not None:
+            e, self._exc = self._exc, None
+            raise RuntimeError("async checkpoint write failed") from e
+
+    def save(self, path: str, state: Any, *, step: Optional[int] = None,
+             extra: Optional[dict] = None, sharded: bool = True) -> None:
+        self._check()
+        if sharded:
+            jobs, meta = snapshot_sharded(state)
+            meta.update(step=step, extra=extra or {})
+            self._q.put(("sharded", path, jobs, meta))
+        else:
+            arrays, meta = _snapshot_npz(state, step, extra)
+            self._q.put(("npz", path, arrays, meta))
+
+    def wait(self) -> None:
+        """Block until all queued writes hit disk (call before exit)."""
+        self._q.join()
+        self._check()
+
+    def close(self) -> None:
+        self.wait()
+        self._q.put(None)
+        self._thread.join(timeout=10.0)
+
+
 def latest_step(directory: str, prefix: str = "ckpt") -> Optional[int]:
-    """Highest step among ``<prefix>_<step>.npz`` files, or None."""
+    """Highest step among ``<prefix>_<step>.npz`` files and committed
+    ``<prefix>_<step>.sharded/`` directories, or None."""
     if not os.path.isdir(directory):
         return None
     steps = []
     for name in os.listdir(directory):
-        if name.startswith(prefix + "_") and name.endswith(".npz"):
-            try:
-                steps.append(int(name[len(prefix) + 1:-4]))
-            except ValueError:
-                continue
+        if not name.startswith(prefix + "_"):
+            continue
+        if name.endswith(".npz"):
+            stem = name[len(prefix) + 1:-4]
+        elif name.endswith(".sharded") and os.path.exists(
+                os.path.join(directory, name, "meta.json")):
+            stem = name[len(prefix) + 1:-8]
+        else:
+            continue
+        try:
+            steps.append(int(stem))
+        except ValueError:
+            continue
     return max(steps) if steps else None
+
+
+def restore_latest(directory: str, template: Any, step: int,
+                   prefix: str = "ckpt") -> tuple[Any, dict]:
+    """Restore step ``step`` from whichever format exists (sharded
+    preferred)."""
+    base = step_path(directory, step, prefix)
+    if os.path.exists(base + ".sharded/meta.json"):
+        return restore_sharded(base, template)
+    return restore(base, template)
 
 
 def step_path(directory: str, step: int, prefix: str = "ckpt") -> str:

@@ -61,7 +61,7 @@ def build_model(config: Config):
         from mpi_tensorflow_tpu.models import resnet
 
         return resnet.build(config.model, num_classes=config.num_classes,
-                            compute_dtype=dt)
+                            compute_dtype=dt, remat=config.remat)
     if config.model == "bert_base":
         import dataclasses as dc
 
@@ -130,16 +130,19 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         raise ValueError(f"unknown sync mode {config.sync!r}")
 
     start_step = 0
-    if config.checkpoint_dir and config.resume:
+    saver = None
+    if config.checkpoint_dir:
         from mpi_tensorflow_tpu.train import checkpoint
 
-        last = checkpoint.latest_step(config.checkpoint_dir)
-        if last is not None:
-            state, _ = checkpoint.restore(
-                checkpoint.step_path(config.checkpoint_dir, last), state)
-            start_step = last + 1
-            if verbose:
-                print(f"[checkpoint] resumed from step {last}")
+        saver = checkpoint.AsyncSaver()
+        if config.resume:
+            last = checkpoint.latest_step(config.checkpoint_dir)
+            if last is not None:
+                state, _ = checkpoint.restore_latest(
+                    config.checkpoint_dir, state, last)
+                start_step = last + 1
+                if verbose:
+                    print(f"[checkpoint] resumed from step {last}")
 
     batch_sharding = NamedSharding(mesh, P("data"))
     rng = jax.random.key(config.seed + 1)
@@ -189,12 +192,14 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
 
     def preempt_checkpoint(t):
         # preemption: flush a checkpoint at the current step and leave —
-        # --resume continues from here (train/preemption.py)
+        # --resume continues from here (train/preemption.py).  Durability
+        # matters more than latency here: wait for the write to land.
         from mpi_tensorflow_tpu.train import checkpoint
 
         jax.block_until_ready(state)
-        checkpoint.save(checkpoint.step_path(config.checkpoint_dir, t),
-                        state, step=t)
+        saver.save(checkpoint.step_path(config.checkpoint_dir, t),
+                   state, step=t)
+        saver.wait()
         if verbose:
             print(f"[preemption] {guard.reason}: checkpointed step {t}, "
                   "exiting cleanly")
@@ -270,12 +275,13 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                 logs.step_trace(r, t, e)
         if config.sync == "avg50" and t != num_steps - 1:  # mpipy.py:91
             state = avg_step(state)
-        if config.checkpoint_dir:
+        if saver is not None:
             from mpi_tensorflow_tpu.train import checkpoint
 
-            checkpoint.save(
-                checkpoint.step_path(config.checkpoint_dir, t),
-                state, step=t)
+            # async: snapshot now (cheap), write on the worker thread — the
+            # train loop does not block on disk at trace points
+            saver.save(checkpoint.step_path(config.checkpoint_dir, t),
+                       state, step=t)
         timer.start()
 
     def run_steps():
@@ -303,6 +309,8 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     finally:
         if guard is not None:
             guard.uninstall()
+        if saver is not None:
+            saver.close()   # every queued checkpoint is on disk before return
     final_err = history[-1][1] if history else float("nan")
     ips = timer.images_per_sec(global_b)
     if verbose:

@@ -39,6 +39,73 @@ class TestRoundTrip:
         np.testing.assert_array_equal(np.asarray(st.params["tok_emb"]),
                                       np.asarray(st2.params["tok_emb"]))
 
+    def test_sharded_roundtrip_fsdp(self, tmp_path):
+        """Pod-scale format: an FSDP 8-way state round-trips with each
+        shard written/read separately — no full-leaf host materialization —
+        and restores with placement intact."""
+        mesh = meshlib.make_mesh({"data": 8})
+        model = bert.BertMlm(bert.BERT_TINY, mesh=mesh)
+        tx = optax.adamw(1e-3)
+        st = gspmd.init_fsdp_state(model, tx, jax.random.key(0), mesh,
+                                   min_size=512)
+        p = str(tmp_path / "ck")
+        checkpoint.save_sharded(p, st, step=3)
+        # sharded leaves produce multiple shard files (not one big blob)
+        import json as _json
+        import os
+
+        with open(p + ".sharded/meta.json") as f:
+            meta = _json.load(f)
+        multi = [lm for lm in meta["leaves"] if len(lm["shards"]) > 1]
+        assert multi, "no leaf was actually written in shards"
+        for lm in multi:
+            for s in lm["shards"]:
+                assert os.path.exists(p + ".sharded/" + s["file"])
+
+        template = gspmd.init_fsdp_state(model, tx, jax.random.key(9), mesh,
+                                         min_size=512)
+        st2, meta2 = checkpoint.restore_sharded(p, template)
+        assert meta2["step"] == 3
+        for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+            if hasattr(a, "sharding"):
+                assert a.sharding == b.sharding
+
+    def test_sharded_restore_across_mesh_change(self, tmp_path):
+        """Saved on an 8-way FSDP mesh, restored onto a 4-device mesh with
+        different placement — each device reads its slice from the files."""
+        mesh8 = meshlib.make_mesh({"data": 8})
+        model8 = bert.BertMlm(bert.BERT_TINY, mesh=mesh8)
+        tx = optax.adamw(1e-3)
+        st = gspmd.init_fsdp_state(model8, tx, jax.random.key(0), mesh8,
+                                   min_size=512)
+        p = str(tmp_path / "ck")
+        checkpoint.save_sharded(p, st)
+
+        mesh4 = meshlib.make_mesh({"data": 4},
+                                  devices=jax.devices()[:4])
+        model4 = bert.BertMlm(bert.BERT_TINY, mesh=mesh4)
+        template = gspmd.init_fsdp_state(model4, tx, jax.random.key(9),
+                                         mesh4, min_size=512)
+        st2, _ = checkpoint.restore_sharded(p, template)
+        for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_async_saver_writes_and_survives(self, tmp_path):
+        model = cnn.MnistCnn()
+        st = step.init_state(model, jax.random.key(1))
+        saver = checkpoint.AsyncSaver()
+        p = str(tmp_path / "ckpt_5")
+        saver.save(p, st, step=5, sharded=True)
+        saver.wait()
+        st2, meta = checkpoint.restore_sharded(
+            p, step.init_state(model, jax.random.key(2)))
+        assert meta["step"] == 5
+        for a, b in zip(jax.tree.leaves(st), jax.tree.leaves(st2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert checkpoint.latest_step(str(tmp_path)) == 5
+        saver.close()
+
     def test_mismatch_raises(self, tmp_path):
         model = cnn.MnistCnn()
         st = step.init_state(model, jax.random.key(1))
