@@ -40,8 +40,16 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
         try:
             jax.distributed.initialize(coordinator_address, num_processes,
                                        process_id)
-        except (RuntimeError, ValueError):
-            pass  # single-process fallback
+        except (RuntimeError, ValueError) as e:
+            # A pod that was configured for multi-host but failed to
+            # initialize must NOT silently degrade to single-process
+            # training (it would train on 1/N of the data at 1/N scale
+            # with no error) — the mpiexec equivalent of a rank failing
+            # to join COMM_WORLD is a launch failure.
+            raise RuntimeError(
+                "distributed initialization failed for an explicitly "
+                f"configured multi-host launch (coordinator="
+                f"{explicit or 'auto-detected env'}): {e}") from e
 
 
 def make_mesh(shape: Optional[Mapping[str, int]] = None,
