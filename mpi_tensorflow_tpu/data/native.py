@@ -39,7 +39,11 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    if not os.path.exists(_LIB_PATH) and not _build():
+    # run make unconditionally (no-op when up to date) so source edits are
+    # never shadowed by a stale binary; a failed build (no make on PATH)
+    # still falls back to a previously built library if one exists
+    _build()
+    if not os.path.exists(_LIB_PATH):
         return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)

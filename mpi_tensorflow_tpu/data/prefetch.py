@@ -44,14 +44,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
+    # run make unconditionally (a no-op when the .so is newer than the
+    # source) so edits to prefetcher.cpp are never shadowed by a stale binary
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        pass
     if not os.path.exists(_LIB_PATH):
-        try:
-            subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                           capture_output=True, timeout=120)
-        except (subprocess.SubprocessError, FileNotFoundError, OSError):
-            return None
-        if not os.path.exists(_LIB_PATH):
-            return None
+        return None
     try:
         lib = ctypes.CDLL(_LIB_PATH)
     except OSError:
@@ -95,29 +96,45 @@ class ThreadPrefetcher:
         self._q: queue.Queue = queue.Queue(maxsize=depth)
         self._n = len(starts)
         self._stop = threading.Event()
+        self._exc: Optional[BaseException] = None
 
         def work():
-            for t0, w in zip(starts, widths):
-                if self._stop.is_set():
-                    return
-                item = assemble_window(tr_d, tr_l, int(t0), int(w),
-                                       window_k, batch) + (int(w),)
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(item, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+            try:
+                for t0, w in zip(starts, widths):
+                    if self._stop.is_set():
+                        return
+                    item = assemble_window(tr_d, tr_l, int(t0), int(w),
+                                           window_k, batch) + (int(w),)
+                    while not self._stop.is_set():
+                        try:
+                            self._q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            except BaseException as e:   # surface in next(), don't hang
+                self._exc = e
 
         self._thread = threading.Thread(target=work, daemon=True)
         self._thread.start()
 
     def next(self):
-        """-> (batches, labels, width) or None when exhausted."""
+        """-> (batches, labels, width) or None when exhausted.  Raises if
+        the worker thread died instead of blocking forever."""
         if self._n == 0:
             return None
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if self._exc is not None:
+                    raise RuntimeError(
+                        "prefetch worker failed") from self._exc
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        "prefetch worker died without producing a window")
         self._n -= 1
-        return self._q.get()
+        return item
 
     def close(self):
         # stop the worker promptly (a preemption exit must not wait for the
@@ -181,9 +198,17 @@ def make_prefetcher(tr_d, tr_l, starts, widths, window_k: int, batch: int,
     lib = get_lib() if force in (None, "native") else None
     if force == "native" and lib is None:
         raise RuntimeError("native prefetcher library unavailable")
+    native_ok = (np.dtype(tr_d.dtype) == np.float32
+                 and np.dtype(tr_l.dtype) == np.int64)
+    if lib is not None and not native_ok:
+        # the C++ path is float32/int64 only; a silent cast here would make
+        # prefetch=native diverge numerically from the inline/thread paths
+        if force == "native":
+            raise ValueError(
+                f"native prefetcher requires float32 data / int64 labels, "
+                f"got {tr_d.dtype}/{tr_l.dtype}")
+        lib = None
     if lib is not None:
-        # NativePrefetcher converts to float32/int64 via ascontiguousarray,
-        # so any input dtype is accepted
         return NativePrefetcher(lib, tr_d, tr_l, starts, widths, window_k,
                                 batch, depth)
     return ThreadPrefetcher(tr_d, tr_l, starts, widths, window_k, batch,

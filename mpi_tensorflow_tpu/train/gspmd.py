@@ -176,13 +176,17 @@ def make_gspmd_train_step(model, mesh: Mesh,
 
 def make_gspmd_multi_step(model, mesh: Mesh,
                           tx: optax.GradientTransformation,
+                          state_template: Optional[GspmdState] = None,
                           grad_accum: int = 1):
     """K GSPMD train steps per dispatch via ``lax.scan`` over stacked
     batches — the transformer counterpart of train/step.py's
     ``make_multi_train_step`` (amortizes per-dispatch latency; used by the
     benchmark harness).  ``batches``/``labels`` carry a leading (K,) axis on
-    every leaf."""
-    one = make_gspmd_train_step(model, mesh, tx, grad_accum=grad_accum)
+    every leaf.  ``state_template`` as in ``make_gspmd_train_step`` — pins
+    output shardings so FSDP states stay sharded across the scan."""
+    one = make_gspmd_train_step(model, mesh, tx,
+                                state_template=state_template,
+                                grad_accum=grad_accum)
 
     def multi(state: GspmdState, batches, labels, rng):
         def body(s, xs):
@@ -191,7 +195,11 @@ def make_gspmd_multi_step(model, mesh: Mesh,
 
         return lax.scan(body, state, (batches, labels))
 
-    return jax.jit(multi, donate_argnums=0)
+    if state_template is None:
+        return jax.jit(multi, donate_argnums=0)
+    out_shardings = (fsdp_lib.state_out_shardings(state_template),
+                     {"loss": meshlib.replicated(mesh)})
+    return jax.jit(multi, donate_argnums=0, out_shardings=out_shardings)
 
 
 def make_gspmd_eval_step(model, mesh: Mesh):

@@ -5,6 +5,8 @@ import pytest
 
 from mpi_tensorflow_tpu.data import idx, mnist, sharding
 
+pytestmark = pytest.mark.quick
+
 
 class TestIdx:
     @pytest.mark.parametrize("gz", [False, True])

@@ -126,6 +126,25 @@ class TestFsdpTraining:
         after = jax.tree.map(lambda x: x.sharding, state)
         assert jax.tree.all(jax.tree.map(lambda a, b: a == b, before, after))
 
+    def test_multi_step_keeps_fsdp_placement(self, dp8):
+        """Scanned multi-stepping must re-scatter sharded params/moments
+        after each update, exactly like the single-step path."""
+        model = bert.BertMlm(TINY, mesh=dp8)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_fsdp_state(model, tx, jax.random.key(0), dp8,
+                                      min_size=512)
+        multi = gspmd.make_gspmd_multi_step(model, dp8, tx,
+                                            state_template=state)
+        batch, targets = _batch(dp8)
+        K = 2
+        stack = jax.tree.map(
+            lambda x: jnp.broadcast_to(x, (K,) + x.shape), (batch, targets))
+        before = jax.tree.map(lambda x: x.sharding, state)
+        state, m = multi(state, stack[0], stack[1], jax.random.key(1))
+        assert np.all(np.isfinite(np.asarray(m["loss"])))
+        after = jax.tree.map(lambda x: x.sharding, state)
+        assert jax.tree.all(jax.tree.map(lambda a, b: a == b, before, after))
+
     def test_fsdp_composes_with_tp(self):
         """2-D layout: model axis from the logical rules + data axis from
         FSDP on the same weight."""

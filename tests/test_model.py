@@ -10,6 +10,8 @@ from mpi_tensorflow_tpu.models import cnn
 from mpi_tensorflow_tpu.models.base import l2_loss
 from mpi_tensorflow_tpu.train import optimizer, step
 
+pytestmark = pytest.mark.quick
+
 
 @pytest.fixture(scope="module")
 def model():

@@ -5,6 +5,8 @@ import pytest
 
 from mpi_tensorflow_tpu.data import idx, mnist, native
 
+pytestmark = pytest.mark.quick
+
 
 @pytest.fixture(scope="module")
 def lib():

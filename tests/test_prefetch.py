@@ -9,6 +9,8 @@ from mpi_tensorflow_tpu.config import Config
 from mpi_tensorflow_tpu.data import prefetch
 from mpi_tensorflow_tpu.train import loop
 
+pytestmark = pytest.mark.quick
+
 
 def _arrays(n_shards=4, local_n=40, batch=8, seed=0):
     rng = np.random.default_rng(seed)

@@ -9,6 +9,8 @@ from mpi_tensorflow_tpu.config import Config
 from mpi_tensorflow_tpu.models import cnn
 from mpi_tensorflow_tpu.train import evaluation, step
 
+pytestmark = pytest.mark.quick
+
 
 @pytest.fixture()
 def setup(mesh8):
