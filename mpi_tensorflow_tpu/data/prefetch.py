@@ -25,7 +25,6 @@ from __future__ import annotations
 import ctypes
 import os
 import queue
-import subprocess
 import threading
 from typing import Optional
 
@@ -44,13 +43,12 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_tried:
         return _lib
     _lib_tried = True
-    # run make unconditionally (a no-op when the .so is newer than the
-    # source) so edits to prefetcher.cpp are never shadowed by a stale binary
-    try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
-                       capture_output=True, timeout=120)
-    except (subprocess.SubprocessError, FileNotFoundError, OSError):
-        pass
+    # one shared build helper (data/native.py): run make unconditionally (a
+    # no-op when up to date) so source edits are never shadowed by a stale
+    # binary; fall back to an existing .so when make is unavailable
+    from mpi_tensorflow_tpu.data import native as _native
+
+    _native._build()
     if not os.path.exists(_LIB_PATH):
         return None
     try:

@@ -172,6 +172,7 @@ class BertMlm:
         Ulysses per ``cfg.sp_impl``) over the seq axis when the mesh shards
         it; otherwise the Pallas flash kernel on TPU (falls back to dense
         when shapes/platform don't allow it)."""
+        on_tpu = jax.devices()[0].platform == "tpu"
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             specs = P("data" if self.mesh.shape.get("data", 1) > 1 else None,
                       "model" if self.mesh.shape.get("model", 1) > 1 else None,
@@ -181,14 +182,26 @@ class BertMlm:
                 if self.cfg.sp_impl == "ulysses":
                     from mpi_tensorflow_tpu.parallel import ulysses
 
-                    return ulysses.ulysses_attention(q, k, v, "seq")
+                    inner_attn = None
+                    if self.use_flash and on_tpu:
+                        # each shard sees the FULL sequence for its heads —
+                        # exactly where the Pallas kernel pays off
+                        from mpi_tensorflow_tpu.ops import \
+                            flash_attention as fa
+
+                        def inner_attn(q, k, v, causal=False, scale=None):
+                            return fa.flash_attention(q, k, v, causal, scale)
+                    return ulysses.ulysses_attention(q, k, v, "seq",
+                                                     inner=inner_attn)
                 return ring.ring_attention(q, k, v, "seq")
 
+            # check_vma=False: pallas_call (the flash inner) cannot declare
+            # varying-mesh-axes metadata on its outputs
             return jax.shard_map(inner, mesh=self.mesh,
                                  in_specs=(specs, specs, specs),
-                                 out_specs=specs)(q, k, v)
-        if self.use_flash and q.shape[2] % 128 == 0 \
-                and jax.devices()[0].platform == "tpu":
+                                 out_specs=specs, check_vma=False)(q, k, v)
+        if self.use_flash and on_tpu:
+            # any S: the kernel pads/masks to the block size internally
             from mpi_tensorflow_tpu.ops import flash_attention as fa
 
             return fa.flash_attention(q, k, v)

@@ -14,6 +14,15 @@ framework goal of first-class long-context training.
 Call ``ring_attention`` inside ``shard_map`` with the ``seq`` axis in scope;
 ``dense_attention`` is the single-shard reference implementation (also used
 when the mesh has no seq axis).
+
+Kernel note: the per-hop online-softmax update stays in XLA rather than the
+Pallas flash kernel (ops/flash_attention.py).  Each hop's score block is
+(S_local, S_local) and lives entirely in registers/VMEM under XLA fusion;
+using the Pallas kernel per hop would require carrying its (o, m, l)
+accumulators through HBM between hops AND a chunk-level custom VJP for the
+scan's backward — cost without benefit at the S_local (<= a few K) a ring
+shard holds.  The Ulysses path is where the kernel pays off (each shard
+sees the full sequence) and does use it (models/bert.py `_attention`).
 """
 
 from __future__ import annotations

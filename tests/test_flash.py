@@ -57,8 +57,33 @@ class TestPallasKernel:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_custom_vjp_grads(self):
-        q, k, v = _rand_qkv(b=1, h=1, s=64, d=16)
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_kernel_backward_matches_dense(self, causal):
+        """The hand-written dq/dkdv Pallas kernels (not a recompute path)
+        against autodiff through dense attention."""
+        q, k, v = _rand_qkv(b=1, h=2, s=64, d=16, seed=3)
+
+        def f_flash(q, k, v):
+            return jnp.sum(fa.flash_attention(q, k, v, causal, None,
+                                              32, 32, True) ** 2)
+
+        def f_dense(q, k, v):
+            return jnp.sum(ring.dense_attention(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_padded_seq_forward_and_backward(self):
+        """S not divisible by the block size runs via padding/masking —
+        round 1 rejected these shapes outright."""
+        q, k, v = _rand_qkv(b=1, h=1, s=100, d=16, seed=5)
+        want = ring.dense_attention(q, k, v)
+        got = fa.flash_attention(q, k, v, False, None, 32, 32, True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-5)
 
         def f_flash(q, k, v):
             return jnp.sum(fa.flash_attention(q, k, v, False, None,
@@ -70,10 +95,6 @@ class TestPallasKernel:
         gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
         gd = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gd):
+            assert np.all(np.isfinite(np.asarray(a)))
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-4)
-
-    def test_indivisible_raises(self):
-        q, k, v = _rand_qkv(s=100)
-        with pytest.raises(AssertionError, match="divisible"):
-            fa.flash_attention(q, k, v, False, None, 128, 128, True)

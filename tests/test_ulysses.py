@@ -53,6 +53,69 @@ class TestUlyssesAttention:
             np.asarray(_sharded(seq_mesh)(q, k, v)),
             np.asarray(ring_f(q, k, v)), rtol=2e-4, atol=2e-5)
 
+    def test_flash_inner_matches_dense(self, seq_mesh):
+        """Ulysses with the Pallas flash kernel (interpret mode) as the
+        local attention — the SP path exercising the kernel, forward and
+        backward (round-1 gap: SP never hit the kernel)."""
+        from mpi_tensorflow_tpu.ops import flash_attention as fa
+
+        q, k, v = _rand_qkv(b=1, h=8, s=64, d=8, seed=7)
+
+        def inner(q, k, v, causal=False, scale=None):
+            return fa.flash_attention(q, k, v, causal, scale, 32, 32, True)
+
+        attn = jax.shard_map(
+            lambda q, k, v: ulysses.ulysses_attention(q, k, v, "seq",
+                                                      inner=inner),
+            mesh=seq_mesh, in_specs=(P(None, None, "seq"),) * 3,
+            out_specs=P(None, None, "seq"), check_vma=False)
+        want = np.asarray(ring.dense_attention(
+            jnp.array(q), jnp.array(k), jnp.array(v)))
+        got = np.asarray(jax.jit(attn)(q, k, v))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+        gs = jax.jit(jax.grad(
+            lambda q, k, v: jnp.sum(attn(q, k, v) ** 2),
+            argnums=(0, 1, 2)))(jnp.array(q), jnp.array(k), jnp.array(v))
+        gd = jax.grad(
+            lambda q, k, v: jnp.sum(ring.dense_attention(q, k, v) ** 2),
+            argnums=(0, 1, 2))(jnp.array(q), jnp.array(k), jnp.array(v))
+        for a, b in zip(gs, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+
+    def test_bert_ulysses_uses_flash_on_tpu(self, seq_mesh, monkeypatch):
+        """The BERT attention wiring passes the flash kernel as the Ulysses
+        inner exactly when on TPU with use_flash."""
+        from mpi_tensorflow_tpu.models import bert
+        from mpi_tensorflow_tpu.parallel import ulysses as ulysses_mod
+
+        seen = {}
+        orig = ulysses_mod.ulysses_attention
+
+        def spy(q, k, v, axis_name="seq", *, inner=None, **kw):
+            seen["inner"] = inner
+            return orig(q, k, v, axis_name, inner=None, **kw)
+
+        from mpi_tensorflow_tpu.parallel import mesh as meshlib
+
+        cfg = dataclasses.replace(bert.BERT_TINY, sp_impl="ulysses",
+                                  heads=8)   # divisible by the seq axis
+        mesh = meshlib.make_mesh({"data": 1, "seq": 8})
+        monkeypatch.setattr(ulysses_mod, "ulysses_attention", spy)
+        # pretend we're on TPU for the gate (after building the mesh —
+        # bert.jax IS the global jax module, so devices() is patched
+        # everywhere)
+        monkeypatch.setattr(
+            bert.jax, "devices",
+            lambda *a: [type("D", (), {"platform": "tpu"})()])
+        model = bert.BertMlm(cfg, mesh=mesh)
+        params = model.init(jax.random.key(0))
+        tokens = jnp.zeros((2, 64), jnp.int32)
+        model.apply(params, tokens)
+        assert seen.get("inner") is not None, \
+            "BERT's Ulysses path did not receive the flash kernel"
+
     def test_gradients_match_dense(self, seq_mesh):
         """All-to-alls are linear, so grads must match dense attention's."""
         q, k, v = _rand_qkv(b=1, h=8, s=32)
