@@ -37,6 +37,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--momentum", type=float, default=d.momentum)
     p.add_argument("--weight-decay", type=float, default=d.weight_decay)
     p.add_argument("--log-every", type=int, default=d.log_every)
+    p.add_argument("--early-stop-patience", type=int,
+                   default=d.early_stop_patience,
+                   help="stop when validation error hasn't improved for N "
+                        "trace points (0 = off, the reference's behavior — "
+                        "it scatters validation shards and never reads "
+                        "them, mpipy.py:236-241)")
     p.add_argument("--sync", choices=["psum", "avg50"], default=d.sync,
                    help="psum: per-step gradient allreduce (sync SGD); "
                         "avg50: the reference's periodic parameter averaging "
@@ -96,6 +102,7 @@ def config_from_args(args) -> Config:
         batch_size=args.batch_size, num_classes=args.num_classes,
         base_lr=args.base_lr, lr_decay=args.lr_decay, momentum=args.momentum,
         weight_decay=args.weight_decay, log_every=args.log_every,
+        early_stop_patience=args.early_stop_patience,
         sync=args.sync, seed=args.seed, data_dir=args.data_dir,
         model=args.model, dataset=args.dataset,
         mesh_shape=parse_mesh(args.mesh),

@@ -37,6 +37,12 @@ class Config:
                                   # (mpipy.py:86) — an accidental cost; we
                                   # evaluate on the log cadence and keep it off
                                   # the timed path (BASELINE.md measurement rule)
+    early_stop_patience: int = 0  # >0: stop when validation error hasn't
+                                  # improved for N trace points.  The
+                                  # reference scatters validation shards and
+                                  # never reads them (mpipy.py:236-241, dead
+                                  # data); 0 keeps that faithful default,
+                                  # >0 puts the split to work
 
     # --- parallelism ---
     sync: str = "psum"            # "psum": per-step gradient summation (the

@@ -31,6 +31,7 @@ implementation.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -96,7 +97,7 @@ def blockwise_attention(q, k, v, *, causal: bool = False,
 
 def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr,
                       l_scr, *, scale: float, causal: bool, block_q: int,
-                      block_k: int, s_valid: int):
+                      block_k: int, s_valid: int, s_pad: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -118,14 +119,17 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr,
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # (BQ, BK)
-        kpos = ki * block_k + lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
-        invalid = kpos >= s_valid
-        if causal:
-            qpos = qi * block_q + lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            invalid = invalid | (kpos > qpos)
-        s = jnp.where(invalid, NEG_BIG, s)
+        # s_valid/s_pad are static: skip mask construction entirely on the
+        # hot aligned non-causal path
+        if causal or s_valid < s_pad:
+            kpos = ki * block_k + lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            invalid = kpos >= s_valid
+            if causal:
+                qpos = qi * block_q + lax.broadcasted_iota(
+                    jnp.int32, (block_q, block_k), 0)
+                invalid = invalid | (kpos > qpos)
+            s = jnp.where(invalid, NEG_BIG, s)
         m_prev = m_scr[:, 0:1]                         # (BQ, 1)
         l_prev = l_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -159,7 +163,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
 
     kernel = functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               s_valid=s_valid)
+                               s_valid=s_valid, s_pad=S)
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((B * H, S, Dv), q.dtype),
@@ -194,22 +198,29 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
 # ---------------------------------------------------------------------------
 
 def _scores_t(k, q, v, do, lse_row, dsum_row, *, scale, causal, s_valid,
-              qi, ki, block_q, block_k):
+              s_pad, qi, ki, block_q, block_k):
     """Shared backward math in the transposed (BK, BQ) orientation:
-    returns (p_t, ds_t)."""
+    returns (p_t, ds_t).  Masks are built only when statically needed."""
     s_t = jax.lax.dot_general(
         k, q, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32) * scale        # (BK, BQ)
-    kpos = ki * block_k + lax.broadcasted_iota(
-        jnp.int32, (block_k, block_q), 0)
-    qpos = qi * block_q + lax.broadcasted_iota(
-        jnp.int32, (block_k, block_q), 1)
-    # padded q columns MUST be masked here: their lse is NEG_BIG, so the
-    # exp would overflow to inf and 0*inf = NaN would poison dk/dv
-    invalid = (kpos >= s_valid) | (qpos >= s_valid)
-    if causal:
-        invalid = invalid | (kpos > qpos)
-    p_t = jnp.where(invalid, 0.0, jnp.exp(s_t - lse_row))  # (BK, BQ)
+    invalid = None
+    if causal or s_valid < s_pad:
+        kpos = ki * block_k + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 0)
+        qpos = qi * block_q + lax.broadcasted_iota(
+            jnp.int32, (block_k, block_q), 1)
+        if s_valid < s_pad:
+            # padded q columns MUST be masked here: their lse is NEG_BIG,
+            # so the exp would overflow to inf and 0*inf = NaN would
+            # poison dk/dv
+            invalid = (kpos >= s_valid) | (qpos >= s_valid)
+        if causal:
+            c = kpos > qpos
+            invalid = c if invalid is None else (invalid | c)
+    p_t = jnp.exp(s_t - lse_row)                           # (BK, BQ)
+    if invalid is not None:
+        p_t = jnp.where(invalid, 0.0, p_t)
     dp_t = jax.lax.dot_general(
         v, do, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)                # (BK, BQ)
@@ -219,7 +230,8 @@ def _scores_t(k, q, v, do, lse_row, dsum_row, *, scale, causal, s_valid,
 
 def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
                      dq_ref, acc, *, scale: float, causal: bool,
-                     block_q: int, block_k: int, s_valid: int):
+                     block_q: int, block_k: int, s_valid: int,
+                     s_pad: int):
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -236,7 +248,8 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         _, ds_t = _scores_t(
             k_ref[0], q_ref[0], v_ref[0], do_ref[0],
             lse_ref[...], dsum_ref[...], scale=scale, causal=causal,
-            s_valid=s_valid, qi=qi, ki=ki, block_q=block_q, block_k=block_k)
+            s_valid=s_valid, s_pad=s_pad, qi=qi, ki=ki,
+            block_q=block_q, block_k=block_k)
         # dq_block = ds^T @ k == contract ds_t's BK dim with k's BK dim
         acc[:] += jax.lax.dot_general(
             ds_t, k_ref[0].astype(jnp.float32), (((0,), (0,)), ((), ())),
@@ -250,7 +263,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
 def _flash_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
                        dk_ref, dv_ref, acc_dk, acc_dv, *, scale: float,
                        causal: bool, block_q: int, block_k: int,
-                       s_valid: int):
+                       s_valid: int, s_pad: int):
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -268,8 +281,8 @@ def _flash_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
         do = do_ref[0]
         p_t, ds_t = _scores_t(
             k_ref[0], q_ref[0], v_ref[0], do, lse_ref[...], dsum_ref[...],
-            scale=scale, causal=causal, s_valid=s_valid, qi=qi, ki=ki,
-            block_q=block_q, block_k=block_k)
+            scale=scale, causal=causal, s_valid=s_valid, s_pad=s_pad,
+            qi=qi, ki=ki, block_q=block_q, block_k=block_k)
         acc_dv[:] += jax.lax.dot_general(
             p_t.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # (BK, Dv)
@@ -313,7 +326,8 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
     ]
     dq = pl.pallas_call(
         functools.partial(_flash_dq_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, s_valid=s_valid),
+                          block_q=block_q, block_k=block_k,
+                          s_valid=s_valid, s_pad=S),
         out_shape=jax.ShapeDtypeStruct((B * H, S, D), q.dtype),
         grid=(B * H, S // block_q, S // block_k),
         in_specs=row_specs,
@@ -339,7 +353,8 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
     ]
     dk, dv = pl.pallas_call(
         functools.partial(_flash_dkdv_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, s_valid=s_valid),
+                          block_q=block_q, block_k=block_k,
+                          s_valid=s_valid, s_pad=S),
         out_shape=(jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
                    jax.ShapeDtypeStruct((B * H, S, Dv), v.dtype)),
         grid=(B * H, S // block_k, S // block_q),
@@ -362,6 +377,13 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
 # public entry: padding + custom VJP (Pallas forward AND backward)
 # ---------------------------------------------------------------------------
 
+def _padded_len(S: int, block_q: int, block_k: int) -> int:
+    """Pad to the lcm so BOTH grid dims divide evenly (padding to just
+    the max would silently drop trailing blocks of the other size)."""
+    blk = math.lcm(block_q, block_k)
+    return -(-S // blk) * blk
+
+
 def _pad_seq(x, S_pad):
     S = x.shape[2]
     if S == S_pad:
@@ -380,14 +402,9 @@ def flash_attention(q, k, v, causal: bool = False,
 
 
 def _fwd_impl(q, k, v, causal, scale, block_q, block_k, interpret):
-    import math
-
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     S = q.shape[2]
-    # pad to the lcm so BOTH grid dims divide evenly (padding to just the
-    # max would silently drop trailing blocks of the other size)
-    blk = math.lcm(block_q, block_k)
-    S_pad = -(-S // blk) * blk
+    S_pad = _padded_len(S, block_q, block_k)
     out_p, lse = _flash_forward(
         _pad_seq(q, S_pad), _pad_seq(k, S_pad), _pad_seq(v, S_pad),
         causal=causal, scale=scale, block_q=block_q, block_k=block_k,
@@ -402,13 +419,10 @@ def _fwd(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _bwd(causal, scale, block_q, block_k, interpret, res, g):
-    import math
-
     q, k, v, out, lse_padded = res   # lse keeps the padded length
     scale_ = scale if scale is not None else q.shape[-1] ** -0.5
     S = q.shape[2]
-    blk = math.lcm(block_q, block_k)
-    S_pad = -(-S // blk) * blk
+    S_pad = _padded_len(S, block_q, block_k)
     dq, dk, dv = _flash_backward(
         _pad_seq(q, S_pad), _pad_seq(k, S_pad), _pad_seq(v, S_pad),
         _pad_seq(out, S_pad), lse_padded, _pad_seq(g, S_pad),

@@ -164,13 +164,42 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     if fused > 1:
         eval_multi = step_lib.make_multi_eval_step(model, config, mesh)
 
-    def run_eval(s):
+    def run_eval(s, data=None):
+        data = splits.test_data if data is None else data
         if eval_multi is not None:
             return evaluation.eval_in_batches_fused(
                 lambda w: eval_multi(s.params, s.model_state, w),
-                splits.test_data, global_b)
+                data, global_b)
         predict = lambda b: eval_step(s.params, s.model_state, b)
-        return evaluation.eval_in_batches(predict, splits.test_data, global_b)
+        return evaluation.eval_in_batches(predict, data, global_b)
+
+    # validation-based early stopping: the reference scatters val shards and
+    # never reads them (mpipy.py:236-241); patience > 0 puts them to work
+    es_patience = int(getattr(config, "early_stop_patience", 0) or 0)
+    es_usable = es_patience > 0 and splits.val_labels.shape[0] >= global_b
+    if es_patience > 0 and not es_usable and verbose:
+        print(f"[early-stop] DISABLED: validation split "
+              f"({splits.val_labels.shape[0]} rows) is smaller than the "
+              f"global batch ({global_b}) — --early-stop-patience ignored")
+    es_best, es_bad, stop_early = [float("inf")], [0], [False]
+
+    def check_early_stop(s) -> bool:
+        if not es_usable:
+            return False
+        preds = run_eval(s, splits.val_data)
+        val_err = error_rate(preds, splits.val_labels)
+        if verbose:
+            logs.val_trace(meshlib.process_index(), val_err)
+        if val_err < es_best[0] - 1e-12:
+            es_best[0], es_bad[0] = val_err, 0
+            return False
+        es_bad[0] += 1
+        if es_bad[0] >= es_patience:
+            if verbose:
+                print(f"[early-stop] validation error has not improved for "
+                      f"{es_patience} trace points (best {es_best[0]:.2f}%)")
+            return True
+        return False
 
     pending = 0
     if fused > 1:
@@ -256,6 +285,8 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                 if (t_done % L == 0 and t_done > 0) \
                         or t_done == num_steps - 1:
                     trace_point(t_done)
+                    if stop_early[0]:
+                        break
         finally:
             if pf is not None:
                 pf.close()
@@ -275,6 +306,8 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                 logs.step_trace(r, t, e)
         if config.sync == "avg50" and t != num_steps - 1:  # mpipy.py:91
             state = avg_step(state)
+        if t != num_steps - 1:   # a verdict at the final step is dead work
+            stop_early[0] = check_early_stop(state)
         if saver is not None:
             from mpi_tensorflow_tpu.train import checkpoint
 
@@ -299,6 +332,8 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
 
             if (t > 0 and t % config.log_every == 0) or t == num_steps - 1:
                 trace_point(t)
+                if stop_early[0]:
+                    break
 
     timer.start()
     try:

@@ -24,6 +24,13 @@ def step_trace(rank: int, step: int, test_error: float) -> None:
     sys.stdout.flush()
 
 
+def val_trace(rank: int, val_error: float) -> None:
+    """Validation-error line (early-stopping mode; no reference analogue —
+    the reference never reads its validation shards, mpipy.py:236-241)."""
+    print(f"{rank}  validation error: {val_error:.1f}%")
+    sys.stdout.flush()
+
+
 def timing_summary(images_per_sec: float, step_time_ms: float,
                    num_devices: int) -> None:
     print(f"[timing] {images_per_sec:,.0f} images/sec "

@@ -21,6 +21,17 @@ def small_config(**kw):
 
 
 class TestTrainLoop:
+    def test_early_stopping_uses_validation_split(self, mesh8, splits):
+        """With patience set, the loop reads the validation shards (the
+        reference's dead data) and stops before the full step budget once
+        val error stops improving."""
+        assert splits.val_labels.shape[0] >= 64, "fixture has no val split"
+        cfg = small_config(epochs=40, early_stop_patience=2, fused_steps=1)
+        res = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+        # synthetic blobs hit 0% val error quickly -> patience must trigger
+        assert len(res.history) < res.num_steps // cfg.log_every, \
+            "early stopping never fired"
+
     def test_psum_end_to_end_converges(self, mesh8, splits):
         cfg = small_config(epochs=4)
         res = loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
