@@ -13,9 +13,20 @@ a ``BertMlm`` whose encoder calls ``parallel.pipeline.pipeline`` inside a
 backward pipeline (reverse ``ppermute`` hops) automatically.
 
 Composition: ``pipe x data`` (each data shard runs its own microbatch
-stream through the stages).  TP/SP inside a stage and the 1F1B schedule are
-future work; the loss-side machinery (masked-position packing, chunked CE)
-is inherited.
+stream through the stages).  The loss-side machinery (masked-position
+packing, chunked CE) is inherited.
+
+Memory schedule: GPipe stores ~M microbatch boundary activations for the
+backward pipeline.  The 1F1B peak of O(P) in-flight activations is obtained
+compositionally: set ``num_microbatches = P`` and use the train step's
+``grad_accum`` to scan over microbatch *groups* — each group pipelines P
+microbatches (peak O(P) activations, exactly 1F1B's), and groups accumulate
+gradients sequentially (pinned by
+tests/test_moe_pipeline.py::test_pipeline_with_grad_accum).  The price vs a
+hand-interleaved 1F1B is bubble fraction ((P-1)/(2P-1) per group instead of
+(P-1)/(M+P-1) overall); ``cfg.remat`` additionally recomputes within-stage
+activations in the backward.  TP/SP inside a stage and a hand-interleaved
+1F1B schedule remain future work.
 
 No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
 """
@@ -100,6 +111,12 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         def body(h, lp):
             return self._plain_layer(lp, h), None
 
+        if self.cfg.remat:
+            # recompute stage activations in the backward pipeline: the
+            # scanned schedule then stores only stage-boundary activations
+            # per tick instead of every layer's internals (the GPipe
+            # activation-memory story)
+            body = jax.checkpoint(body)
         h, _ = lax.scan(body, x, stage_params)
         return h
 
