@@ -37,9 +37,17 @@ from mpi_tensorflow_tpu.models.bert import _norm_init
 @dataclasses.dataclass(frozen=True)
 class MoeConfig:
     num_experts: int = 4
+    top_k: int = 1               # 1 = Switch routing; 2 = GShard-style
+                                 # (second choice fills remaining capacity,
+                                 # outputs combined with normalized gates)
     capacity_factor: float = 1.25  # expert buffer = cf * tokens / experts
     aux_loss_weight: float = 0.01
     every_other: bool = True     # MoE on odd layers, dense MLP on even
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 (Switch) or 2 (GShard), "
+                             f"got {self.top_k}")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,7 +101,8 @@ class MoeBertMlm(bert_lib.BertMlm):
         return max(8, ((c + 7) // 8) * 8)
 
     def _moe_mlp(self, h, lp):
-        """Capacity-routed top-1 expert MLP.  h: (B, S, E) -> (out, aux)."""
+        """Capacity-routed top-k (k in {1, 2}) expert MLP.
+        h: (B, S, E) -> (out, aux)."""
         dt = self.cfg.dtype
         X = self.moe.num_experts
         B, S, E = h.shape
@@ -101,21 +110,41 @@ class MoeBertMlm(bert_lib.BertMlm):
         C = self.capacity(N)
         hf = h.reshape(N, E)
 
-        # --- route: top-1 expert + position in that expert's buffer ---
+        # --- route: top-k experts + positions in their buffers ---
         gate_logits = jnp.einsum("ne,ec->nc", hf, lp["router"].astype(dt))
         gates = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
         top1 = jnp.argmax(gates, axis=-1)                       # (N,)
-        top_gate = jnp.take_along_axis(gates, top1[:, None],
-                                       axis=-1)[:, 0]           # (N,)
-        onehot = jax.nn.one_hot(top1, X, dtype=jnp.int32)       # (N, X)
+        gate1 = jnp.take_along_axis(gates, top1[:, None],
+                                    axis=-1)[:, 0]              # (N,)
+        onehot1 = jax.nn.one_hot(top1, X, dtype=jnp.int32)      # (N, X)
         # k-th token routed to expert x gets buffer slot k (first-come)
-        pos = jnp.sum(jnp.cumsum(onehot, axis=0) * onehot, axis=-1) - 1
-        keep = pos < C                                          # drop overflow
+        pos1 = jnp.sum(jnp.cumsum(onehot1, axis=0) * onehot1, axis=-1) - 1
+        keep1 = pos1 < C                                        # drop overflow
         # dropped tokens target the sacrificial overflow row X*C
-        slot = jnp.where(keep, top1 * C + pos, X * C)           # (N,)
+        slot1 = jnp.where(keep1, top1 * C + pos1, X * C)        # (N,)
+
+        routes = [(slot1, keep1, gate1)]
+        if self.moe.top_k == 2:
+            # GShard-style second choice: fills whatever capacity the
+            # first-choice assignment left in each expert's buffer
+            g2 = gates - gates * jax.nn.one_hot(top1, X)        # mask choice 1
+            top2 = jnp.argmax(g2, axis=-1)
+            gate2 = jnp.take_along_axis(g2, top2[:, None], axis=-1)[:, 0]
+            onehot2 = jax.nn.one_hot(top2, X, dtype=jnp.int32)
+            occupancy1 = jnp.minimum(jnp.sum(onehot1, axis=0), C)   # (X,)
+            pos2 = jnp.sum(jnp.cumsum(onehot2, axis=0) * onehot2,
+                           axis=-1) - 1 + occupancy1[top2]
+            keep2 = pos2 < C
+            slot2 = jnp.where(keep2, top2 * C + pos2, X * C)
+            # normalize the two gates over what was actually routed
+            denom = jnp.maximum(gate1 + gate2, 1e-9)
+            routes = [(slot1, keep1, gate1 / denom),
+                      (slot2, keep2, gate2 / denom)]
 
         # --- dispatch: scatter tokens into the (X, C, E) expert buffers ---
-        buf = jnp.zeros((X * C + 1, E), dt).at[slot].set(hf.astype(dt))
+        buf = jnp.zeros((X * C + 1, E), dt)
+        for slot, _, _ in routes:
+            buf = buf.at[slot].set(hf.astype(dt))
         xin = buf[:X * C].reshape(X, C, E)
         xin = self._constrain(xin, ("expert", "capacity", "embed"))
 
@@ -127,15 +156,18 @@ class MoeBertMlm(bert_lib.BertMlm):
             + lp["eb2"].astype(dt)[:, None, :]
         xout = self._constrain(xout, ("expert", "capacity", "embed"))
 
-        # --- combine: gather each token's expert output (zero if dropped —
-        # the residual connection in the encoder carries it unchanged) ---
+        # --- combine: gather each token's expert output(s) (zero if
+        # dropped — the residual connection carries it unchanged) ---
         flat = jnp.concatenate([xout.reshape(X * C, E),
                                 jnp.zeros((1, E), dt)], axis=0)
-        out = flat[slot] * (top_gate * keep)[:, None].astype(dt)
+        out = jnp.zeros((N, E), dt)
+        for slot, keep, w in routes:
+            out = out + flat[slot] * (w * keep)[:, None].astype(dt)
         out = out.reshape(B, S, E)
 
         # Switch load-balance loss: X * sum_x frac_tokens_x * mean_gate_x
-        frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+        # (first-choice fractions, as in both Switch and GShard)
+        frac = jnp.mean(onehot1.astype(jnp.float32), axis=0)
         mean_gate = jnp.mean(gates, axis=0)
         aux = X * jnp.sum(frac * mean_gate)
         return out, aux

@@ -1,0 +1,120 @@
+"""Shared checkpoint/resume/preemption scaffolding for the training loops.
+
+One implementation used by both the image loop (train/loop.py) and the MLM
+loop (train/mlm_loop.py) — resume-from-latest, async trace-point saves, and
+preemption handling, including the multi-host subtlety: a SIGTERM observed
+at different python-loop steps on different hosts must NOT lead each host
+to checkpoint (or stop enqueueing collectives) at a different step.  On
+multi-host runs the stop decision is therefore *agreed* at trace cadence
+via a tiny allgather — every process stops, saves, and names the checkpoint
+identically.  Single-host runs keep per-step stop granularity (no
+collective needed).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+
+from mpi_tensorflow_tpu.train import checkpoint, preemption
+
+
+class CheckpointHooks:
+    """Loop-side checkpoint machinery.
+
+    Usage::
+
+        hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
+        state, start = hooks.resume(state) if config.resume else (state, 0)
+        for t in ...:
+            ...
+            if hooks.stop_now(t):          # per-step (single-host only)
+                hooks.preempt_save(state, t); break
+            if trace_point:
+                hooks.save_async(state, t)
+                if hooks.stop_agreed(t):   # trace-cadence (all hosts)
+                    hooks.preempt_save(state, t); break
+        hooks.close()
+    """
+
+    def __init__(self, checkpoint_dir: Optional[str], *,
+                 verbose: bool = True) -> None:
+        self.dir = checkpoint_dir
+        self.verbose = verbose
+        self.saver: Optional[checkpoint.AsyncSaver] = None
+        self.guard: Optional[preemption.PreemptionGuard] = None
+        if checkpoint_dir:
+            self.saver = checkpoint.AsyncSaver()
+            try:
+                self.guard = preemption.PreemptionGuard.install()
+            except ValueError:
+                self.guard = None   # signal handlers need the main thread
+
+    @property
+    def active(self) -> bool:
+        return self.saver is not None
+
+    # -- resume --
+
+    def resume(self, state: Any) -> Tuple[Any, int]:
+        """(state, start_step) from the latest committed checkpoint."""
+        if not self.dir:
+            return state, 0
+        last = checkpoint.latest_step(self.dir)
+        if last is None:
+            return state, 0
+        state, _ = checkpoint.restore_latest(self.dir, state, last)
+        if self.verbose:
+            print(f"[checkpoint] resumed from step {last}")
+        return state, last + 1
+
+    # -- stopping --
+
+    def stop_now(self, t: int) -> bool:
+        """Per-step local check — only valid single-host (a lone host
+        breaking out of the loop would deadlock the pod's collectives)."""
+        return (self.guard is not None and self.guard.should_stop
+                and jax.process_count() == 1)
+
+    def stop_agreed(self, t: int) -> bool:
+        """Trace-cadence check, agreed across processes: stop iff ANY host
+        observed the signal.  Every process calls this at the same loop
+        point, so all stop at the same step."""
+        if self.guard is None:
+            return False
+        local = self.guard.should_stop
+        if jax.process_count() == 1:
+            return local
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([local], dtype=np.bool_))
+        agreed = bool(np.any(flags))
+        if agreed and not local:
+            self.guard.request_stop("peer preemption")
+        return agreed
+
+    # -- saving --
+
+    def save_async(self, state: Any, t: int) -> None:
+        """Queue a checkpoint write; does not block the loop on disk."""
+        if self.saver is not None:
+            self.saver.save(checkpoint.step_path(self.dir, t), state, step=t)
+
+    def preempt_save(self, state: Any, t: int) -> None:
+        """Durable checkpoint before a preemption exit."""
+        jax.block_until_ready(state)
+        self.saver.save(checkpoint.step_path(self.dir, t), state, step=t)
+        self.saver.wait()
+        if self.verbose:
+            reason = self.guard.reason if self.guard else "stop"
+            print(f"[preemption] {reason}: checkpointed step {t}, "
+                  "exiting cleanly")
+
+    def close(self) -> None:
+        if self.guard is not None:
+            self.guard.uninstall()
+        if self.saver is not None:
+            self.saver.close()

@@ -129,33 +129,17 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     else:
         raise ValueError(f"unknown sync mode {config.sync!r}")
 
-    start_step = 0
-    saver = None
-    if config.checkpoint_dir:
-        from mpi_tensorflow_tpu.train import checkpoint
+    from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
 
-        saver = checkpoint.AsyncSaver()
-        if config.resume:
-            last = checkpoint.latest_step(config.checkpoint_dir)
-            if last is not None:
-                state, _ = checkpoint.restore_latest(
-                    config.checkpoint_dir, state, last)
-                start_step = last + 1
-                if verbose:
-                    print(f"[checkpoint] resumed from step {last}")
+    hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
+    start_step = 0
+    if config.resume:
+        state, start_step = hooks.resume(state)
 
     batch_sharding = NamedSharding(mesh, P("data"))
     rng = jax.random.key(config.seed + 1)
     timer = StepTimer(warmup_steps=1)
     history = []
-    guard = None
-    if config.checkpoint_dir:
-        from mpi_tensorflow_tpu.train import preemption
-
-        try:
-            guard = preemption.PreemptionGuard.install()
-        except ValueError:
-            guard = None   # signal handlers need the main thread
     if verbose:
         logs.session_start(meshlib.process_index())
 
@@ -219,20 +203,6 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         bs, ls = prefetch.assemble_window(tr_d, tr_l, t, 1, 1, b)
         return bs[0], ls[0]
 
-    def preempt_checkpoint(t):
-        # preemption: flush a checkpoint at the current step and leave —
-        # --resume continues from here (train/preemption.py).  Durability
-        # matters more than latency here: wait for the write to land.
-        from mpi_tensorflow_tpu.train import checkpoint
-
-        jax.block_until_ready(state)
-        saver.save(checkpoint.step_path(config.checkpoint_dir, t),
-                   state, step=t)
-        saver.wait()
-        if verbose:
-            print(f"[preemption] {guard.reason}: checkpointed step {t}, "
-                  "exiting cleanly")
-
     def window_schedule():
         """(starts, widths): fixed-K windows ending exactly on the 50-step
         trace cadence, so the eval/avg/checkpoint schedule matches the
@@ -278,14 +248,17 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                 pending += w
                 t_done = t0 + w - 1
 
-                if guard is not None and guard.should_stop:
-                    preempt_checkpoint(t_done)
+                if hooks.stop_now(t_done):
+                    hooks.preempt_save(state, t_done)
                     break
 
                 if (t_done % L == 0 and t_done > 0) \
                         or t_done == num_steps - 1:
                     trace_point(t_done)
                     if stop_early[0]:
+                        break
+                    if t_done != num_steps - 1 and hooks.stop_agreed(t_done):
+                        hooks.preempt_save(state, t_done)
                         break
         finally:
             if pf is not None:
@@ -308,13 +281,9 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
             state = avg_step(state)
         if t != num_steps - 1:   # a verdict at the final step is dead work
             stop_early[0] = check_early_stop(state)
-        if saver is not None:
-            from mpi_tensorflow_tpu.train import checkpoint
-
-            # async: snapshot now (cheap), write on the worker thread — the
-            # train loop does not block on disk at trace points
-            saver.save(checkpoint.step_path(config.checkpoint_dir, t),
-                       state, step=t)
+        # async: snapshot now (cheap), write on the worker thread — the
+        # train loop does not block on disk at trace points
+        hooks.save_async(state, t)
         timer.start()
 
     def run_steps():
@@ -326,13 +295,16 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
             state, metrics = train_step(state, batch, labels, rng)
             pending += 1
 
-            if guard is not None and guard.should_stop:
-                preempt_checkpoint(t)
+            if hooks.stop_now(t):
+                hooks.preempt_save(state, t)
                 break
 
             if (t > 0 and t % config.log_every == 0) or t == num_steps - 1:
                 trace_point(t)
                 if stop_early[0]:
+                    break
+                if t != num_steps - 1 and hooks.stop_agreed(t):
+                    hooks.preempt_save(state, t)
                     break
 
     timer.start()
@@ -342,10 +314,7 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         else:
             run_steps()
     finally:
-        if guard is not None:
-            guard.uninstall()
-        if saver is not None:
-            saver.close()   # every queued checkpoint is on disk before return
+        hooks.close()   # every queued checkpoint is on disk before return
     final_err = history[-1][1] if history else float("nan")
     ips = timer.images_per_sec(global_b)
     if verbose:

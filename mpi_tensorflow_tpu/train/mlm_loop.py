@@ -71,6 +71,13 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         model, mesh, tx, grad_accum=getattr(config, "grad_accum", 1))
     eval_step = gspmd.make_gspmd_eval_step(model, mesh)
 
+    from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
+
+    hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
+    start_step = 0
+    if config.resume:
+        state, start_step = hooks.resume(state)
+
     tokens, targets, mask = synthetic.mlm_batches(
         train_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
         seed=config.seed)
@@ -99,13 +106,18 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
     pending = 0
     timer.start()
-    for t in range(num_steps):
+    for t in range(start_step, num_steps):
         lo = (t * b) % max(train_n - b, 1)
         batch = gspmd.shard_batch(
             {"tokens": tokens[lo:lo + b], "mask": mask[lo:lo + b]}, mesh)
         tgt = gspmd.shard_batch(targets[lo:lo + b], mesh)
         state, metrics = train_step(state, batch, tgt, rng)
         pending += 1
+
+        if hooks.stop_now(t):
+            hooks.preempt_save(state, t)
+            break
+
         last = t == num_steps - 1
         if (t > 0 and t % config.log_every == 0) or last:
             jax.block_until_ready(state)
@@ -115,8 +127,13 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
             history.append((t, err))
             if verbose:
                 logs.step_trace(meshlib.process_index(), t, err)
+            hooks.save_async(state, t)
+            if not last and hooks.stop_agreed(t):
+                hooks.preempt_save(state, t)
+                break
             timer.start()
 
+    hooks.close()
     final_err = history[-1][1] if history else float("nan")
     sec = timer.mean_step_seconds
     tps = b * seq_len / sec if sec == sec and sec > 0 else float("nan")

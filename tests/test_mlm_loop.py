@@ -23,3 +23,25 @@ class TestMlmLoop:
         # (copy-from-context task; calibrated trajectory reaches ~95% by
         # step 128 and keeps falling with more steps)
         assert res.final_error < 97.0, res.history
+
+    def test_checkpoint_resume(self, tmp_path):
+        """--checkpoint-dir/--resume work for the transformer loop (round-2
+        gap: only the image loop checkpointed)."""
+        mesh = meshlib.make_mesh({"data": 8})
+        common = dict(bert_cfg=bert.BERT_TINY, mesh=mesh, seq_len=32,
+                      train_n=128, test_n=64, learning_rate=3e-3,
+                      verbose=False)
+        cfg = Config(epochs=4, batch_size=4, log_every=16, seed=1,
+                     checkpoint_dir=str(tmp_path))
+        res1 = mlm_loop.train_mlm(cfg, **common)
+        from mpi_tensorflow_tpu.train import checkpoint
+
+        last = checkpoint.latest_step(str(tmp_path))
+        assert last is not None and last > 0
+
+        cfg2 = Config(epochs=8, batch_size=4, log_every=16, seed=1,
+                      checkpoint_dir=str(tmp_path), resume=True)
+        res2 = mlm_loop.train_mlm(cfg2, **common)
+        # resumed run starts past the checkpoint and continues improving
+        assert res2.history[0][0] > last
+        assert np.isfinite(res2.final_error)
