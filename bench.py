@@ -59,7 +59,8 @@ def _measure_scanned(multi_step, state, batches, labels, key, scan_steps,
 def measure_bert(batch_size: int, steps: int, precision: str,
                  scan_steps: int, seq_len: int = 128,
                  ce_impl: str = "auto", ce_chunk: int = 2048,
-                 model_name: str = "bert_base", remat: bool = False) -> dict:
+                 model_name: str = "bert_base", remat: bool = False,
+                 params_bf16: bool = False) -> dict:
     """BERT-base MLM train-step throughput (BASELINE config 5) via the
     GSPMD path — adamw, tied-decoder MLM loss, scanned dispatches.
     ``model_name="moe_bert"`` swaps in the capacity-routed MoE variant
@@ -89,7 +90,11 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     else:
         model = bert.BertMlm(bcfg, mesh=mesh)
     tx = optax.adamw(1e-4)
-    state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
+    import jax.numpy as jnp
+
+    state = gspmd.init_gspmd_state(
+        model, tx, jax.random.key(0), mesh,
+        param_dtype=jnp.bfloat16 if params_bf16 else None)
     multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
 
     K = max(1, min(scan_steps, steps))
@@ -119,6 +124,7 @@ def measure_bert(batch_size: int, steps: int, precision: str,
         "scan_steps": K,
         "ce_impl": ce_impl,
         "ce_chunk": ce_chunk,
+        "params_bf16": params_bf16,
         "platform": jax.devices()[0].platform,
     }
 
@@ -299,6 +305,10 @@ def main(argv=None) -> int:
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize residual blocks / encoder layers "
                          "(frees HBM for larger batches)")
+    ap.add_argument("--params-bf16", action="store_true",
+                    help="store live parameters in bfloat16 with fp32 "
+                         "master weights in the optimizer (halves weight "
+                         "HBM traffic; BERT/MoE path)")
     ap.add_argument("--precision", choices=["fp32", "bf16"], default="fp32",
                     help="compute dtype for the timed train step. fp32 is "
                          "the like-for-like reference comparison AND the "
@@ -342,6 +352,12 @@ def main(argv=None) -> int:
         ap.error("--record-baseline records the MNIST reference baseline; "
                  "drop --model or use mnist_cnn")
 
+    if args.params_bf16 and args.precision != "bf16":
+        # bf16 live params under fp32 compute would silently benchmark
+        # bf16-rounded weights while reporting precision=fp32
+        ap.error("--params-bf16 requires --precision bf16 (fp32 compute "
+                 "with bf16-truncated weights is not the fp32 baseline)")
+
     spec = MODEL_SPECS[args.model]
     batch = args.batch_size if args.batch_size is not None else spec["batch"]
     steps = args.steps or spec["steps"]
@@ -352,7 +368,7 @@ def main(argv=None) -> int:
                               precision=args.precision, scan_steps=scan,
                               seq_len=spec["seq"], ce_impl=args.ce,
                               ce_chunk=args.ce_chunk, model_name=args.model,
-                              remat=args.remat)
+                              remat=args.remat, params_bf16=args.params_bf16)
         label = ("MoE-BERT (capacity-routed EP)" if args.model == "moe_bert"
                  else "BERT-base")
         print(json.dumps({

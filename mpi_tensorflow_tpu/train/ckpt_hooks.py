@@ -103,10 +103,16 @@ class CheckpointHooks:
         if self.saver is not None:
             self.saver.save(checkpoint.step_path(self.dir, t), state, step=t)
 
-    def preempt_save(self, state: Any, t: int) -> None:
-        """Durable checkpoint before a preemption exit."""
+    def preempt_save(self, state: Any, t: int, *,
+                     already_queued: bool = False) -> None:
+        """Durable checkpoint before a preemption exit.  Pass
+        ``already_queued=True`` when ``save_async(state, t)`` was just
+        called for the same step — then this only waits for the flush
+        instead of writing the full state twice under the grace deadline."""
         jax.block_until_ready(state)
-        self.saver.save(checkpoint.step_path(self.dir, t), state, step=t)
+        if not already_queued:
+            self.saver.save(checkpoint.step_path(self.dir, t), state,
+                            step=t)
         self.saver.wait()
         if self.verbose:
             reason = self.guard.reason if self.guard else "stop"

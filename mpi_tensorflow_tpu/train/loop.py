@@ -258,7 +258,8 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                     if stop_early[0]:
                         break
                     if t_done != num_steps - 1 and hooks.stop_agreed(t_done):
-                        hooks.preempt_save(state, t_done)
+                        hooks.preempt_save(state, t_done,
+                                           already_queued=True)
                         break
         finally:
             if pf is not None:
@@ -304,7 +305,7 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
                 if stop_early[0]:
                     break
                 if t != num_steps - 1 and hooks.stop_agreed(t):
-                    hooks.preempt_save(state, t)
+                    hooks.preempt_save(state, t, already_queued=True)
                     break
 
     timer.start()

@@ -106,34 +106,35 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
     pending = 0
     timer.start()
-    for t in range(start_step, num_steps):
-        lo = (t * b) % max(train_n - b, 1)
-        batch = gspmd.shard_batch(
-            {"tokens": tokens[lo:lo + b], "mask": mask[lo:lo + b]}, mesh)
-        tgt = gspmd.shard_batch(targets[lo:lo + b], mesh)
-        state, metrics = train_step(state, batch, tgt, rng)
-        pending += 1
+    try:
+        for t in range(start_step, num_steps):
+            lo = (t * b) % max(train_n - b, 1)
+            batch = gspmd.shard_batch(
+                {"tokens": tokens[lo:lo + b], "mask": mask[lo:lo + b]}, mesh)
+            tgt = gspmd.shard_batch(targets[lo:lo + b], mesh)
+            state, metrics = train_step(state, batch, tgt, rng)
+            pending += 1
 
-        if hooks.stop_now(t):
-            hooks.preempt_save(state, t)
-            break
-
-        last = t == num_steps - 1
-        if (t > 0 and t % config.log_every == 0) or last:
-            jax.block_until_ready(state)
-            timer.stop(pending)
-            pending = 0
-            err = masked_error(state)
-            history.append((t, err))
-            if verbose:
-                logs.step_trace(meshlib.process_index(), t, err)
-            hooks.save_async(state, t)
-            if not last and hooks.stop_agreed(t):
+            if hooks.stop_now(t):
                 hooks.preempt_save(state, t)
                 break
-            timer.start()
 
-    hooks.close()
+            last = t == num_steps - 1
+            if (t > 0 and t % config.log_every == 0) or last:
+                jax.block_until_ready(state)
+                timer.stop(pending)
+                pending = 0
+                err = masked_error(state)
+                history.append((t, err))
+                if verbose:
+                    logs.step_trace(meshlib.process_index(), t, err)
+                hooks.save_async(state, t)
+                if not last and hooks.stop_agreed(t):
+                    hooks.preempt_save(state, t, already_queued=True)
+                    break
+                timer.start()
+    finally:
+        hooks.close()
     final_err = history[-1][1] if history else float("nan")
     sec = timer.mean_step_seconds
     tps = b * seq_len / sec if sec == sec and sec > 0 else float("nan")
