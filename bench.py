@@ -357,6 +357,10 @@ def main(argv=None) -> int:
         # bf16-rounded weights while reporting precision=fp32
         ap.error("--params-bf16 requires --precision bf16 (fp32 compute "
                  "with bf16-truncated weights is not the fp32 baseline)")
+    if args.params_bf16 and args.model not in ("bert_base", "moe_bert"):
+        ap.error("--params-bf16 is implemented for the transformer families "
+                 "(bert_base, moe_bert) only — the image paths would "
+                 "silently ignore it")
 
     spec = MODEL_SPECS[args.model]
     batch = args.batch_size if args.batch_size is not None else spec["batch"]

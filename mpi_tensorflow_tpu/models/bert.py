@@ -189,8 +189,11 @@ class BertMlm:
                         from mpi_tensorflow_tpu.ops import \
                             flash_attention as fa
 
-                        def inner_attn(q, k, v, causal=False, scale=None):
-                            return fa.flash_attention(q, k, v, causal, scale)
+                        if fa.kernel_supported(jnp.dtype(q.dtype).name):
+                            def inner_attn(q, k, v, causal=False,
+                                           scale=None):
+                                return fa.flash_attention(q, k, v, causal,
+                                                          scale)
                     return ulysses.ulysses_attention(q, k, v, "seq",
                                                      inner=inner_attn)
                 return ring.ring_attention(q, k, v, "seq")
@@ -201,10 +204,13 @@ class BertMlm:
                                  in_specs=(specs, specs, specs),
                                  out_specs=specs, check_vma=False)(q, k, v)
         if self.use_flash and on_tpu:
-            # any S: the kernel pads/masks to the block size internally
+            # any S: the kernel pads/masks to the block size internally;
+            # kernel_supported() guards against a Mosaic regression (falls
+            # back to XLA attention instead of failing the train step)
             from mpi_tensorflow_tpu.ops import flash_attention as fa
 
-            return fa.flash_attention(q, k, v)
+            if fa.kernel_supported(jnp.dtype(q.dtype).name):
+                return fa.flash_attention(q, k, v)
         return ring.dense_attention(q, k, v)
 
     def _mlp_block(self, lp, h, idx: int):

@@ -377,6 +377,31 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
 # public entry: padding + custom VJP (Pallas forward AND backward)
 # ---------------------------------------------------------------------------
 
+@functools.lru_cache(maxsize=8)
+def kernel_supported(dtype_name: str = "bfloat16") -> bool:
+    """One-time probe per dtype: do the fwd+bwd kernels compile for this
+    backend's Mosaic?  Model code gates on this (passing the dtype it will
+    actually run) so a toolchain regression degrades to the XLA attention
+    paths instead of killing the training step.  The probe shape fixes
+    D=64/S=128; other head dims share the same Mosaic surface."""
+    import jax as _jax
+
+    try:
+        if _jax.devices()[0].platform != "tpu":
+            return False
+        q = jnp.zeros((1, 1, 128, 64), jnp.dtype(dtype_name))
+
+        def f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+
+        _jax.jit(_jax.grad(f, argnums=(0, 1, 2))).lower(q, q, q).compile()
+        return True
+    except Exception as e:   # noqa: BLE001 — any compile failure disables
+        print(f"[flash_attention] Pallas kernel probe failed for "
+              f"{dtype_name}; falling back to XLA attention ({e!r})")
+        return False
+
+
 def _padded_len(S: int, block_q: int, block_k: int) -> int:
     """Pad to the lcm so BOTH grid dims divide evenly (padding to just
     the max would silently drop trailing blocks of the other size)."""

@@ -106,6 +106,13 @@ def init_fsdp_state(model, tx: optax.GradientTransformation, rng,
     return GspmdState(params, opt, mstate, step)
 
 
+def grad_accum_dtype(opt_state) -> Optional[Any]:
+    """Accumulation dtype for scanned microbatch gradients: fp32 when the
+    optimizer keeps fp32 masters (live params — and thus per-microbatch
+    grads — are low precision), None (= grad dtype) otherwise."""
+    return jnp.float32 if isinstance(opt_state, MasterOpt) else None
+
+
 def shard_batch(tree: Any, mesh: Mesh):
     """Place host batch arrays: leading dim over ``data``, second dim over
     ``seq`` when the mesh has one (token grids are (B, S))."""
@@ -166,8 +173,7 @@ def make_gspmd_train_step(model, mesh: Mesh,
             # with bf16 live params the per-microbatch grads come out bf16;
             # accumulate in fp32 or small contributions are swallowed —
             # exactly the error mode the fp32 masters exist to avoid
-            acc_dtype = (jnp.float32 if isinstance(state.opt, MasterOpt)
-                         else None)
+            acc_dtype = grad_accum_dtype(state.opt)
 
             def up(g):
                 if acc_dtype and jnp.issubdtype(g.dtype, jnp.floating):

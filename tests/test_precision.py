@@ -139,13 +139,19 @@ class TestMasterWeights:
             state.params, state.opt.master)
 
     def test_grad_accum_accumulates_fp32(self):
-        """Microbatch gradients accumulate in fp32 even when live params
-        (and thus per-microbatch grads) are bf16."""
+        """Microbatch gradients accumulate in fp32 exactly when the
+        optimizer keeps fp32 masters (bf16 per-microbatch grads would
+        otherwise swallow small contributions), and the accum path runs."""
         import optax
 
         from mpi_tensorflow_tpu.train import gspmd
 
+        # the dtype decision itself (what the scan accumulator is built as)
         state, _, batch, tgt = self._setup(jnp.bfloat16)
+        assert gspmd.grad_accum_dtype(state.opt) == jnp.float32
+        s_f32, _, _, _ = self._setup(None)
+        assert gspmd.grad_accum_dtype(s_f32.opt) is None
+
         mesh = meshlib.make_mesh({"data": 8})
         cfg = dataclasses.replace(bert.BERT_TINY, dtype=jnp.bfloat16)
         model = bert.BertMlm(cfg, mesh=mesh)

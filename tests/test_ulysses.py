@@ -105,7 +105,10 @@ class TestUlyssesAttention:
         monkeypatch.setattr(ulysses_mod, "ulysses_attention", spy)
         # pretend we're on TPU for the gate (after building the mesh —
         # bert.jax IS the global jax module, so devices() is patched
-        # everywhere)
+        # everywhere), and short-circuit the Mosaic compile probe
+        from mpi_tensorflow_tpu.ops import flash_attention as fa
+
+        monkeypatch.setattr(fa, "kernel_supported", lambda *a: True)
         monkeypatch.setattr(
             bert.jax, "devices",
             lambda *a: [type("D", (), {"platform": "tpu"})()])
