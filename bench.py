@@ -33,6 +33,7 @@ MODEL_SPECS = {
                      scan=8, steps=48, unit="images"),
     "bert_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
     "moe_bert": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
+    "gpt_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
 }
 
 
@@ -87,6 +88,11 @@ def measure_bert(batch_size: int, steps: int, precision: str,
         from mpi_tensorflow_tpu.models import moe
 
         model = moe.MoeBertMlm(bcfg, mesh=mesh)
+    elif model_name == "gpt_base":
+        from mpi_tensorflow_tpu.models import gpt
+
+        # causal LM: every position carries loss (ce_positions is unused)
+        model = gpt.CausalLm(bcfg, mesh=mesh)
     else:
         model = bert.BertMlm(bcfg, mesh=mesh)
     tx = optax.adamw(1e-4)
@@ -357,26 +363,28 @@ def main(argv=None) -> int:
         # bf16-rounded weights while reporting precision=fp32
         ap.error("--params-bf16 requires --precision bf16 (fp32 compute "
                  "with bf16-truncated weights is not the fp32 baseline)")
-    if args.params_bf16 and args.model not in ("bert_base", "moe_bert"):
+    if args.params_bf16 and args.model not in ("bert_base", "moe_bert",
+                                               "gpt_base"):
         ap.error("--params-bf16 is implemented for the transformer families "
-                 "(bert_base, moe_bert) only — the image paths would "
-                 "silently ignore it")
+                 "(bert_base, moe_bert, gpt_base) only — the image paths "
+                 "would silently ignore it")
 
     spec = MODEL_SPECS[args.model]
     batch = args.batch_size if args.batch_size is not None else spec["batch"]
     steps = args.steps or spec["steps"]
     scan = args.scan_steps if args.scan_steps is not None else spec["scan"]
 
-    if args.model in ("bert_base", "moe_bert"):
+    if args.model in ("bert_base", "moe_bert", "gpt_base"):
         result = measure_bert(batch_size=batch, steps=steps,
                               precision=args.precision, scan_steps=scan,
                               seq_len=spec["seq"], ce_impl=args.ce,
                               ce_chunk=args.ce_chunk, model_name=args.model,
                               remat=args.remat, params_bf16=args.params_bf16)
-        label = ("MoE-BERT (capacity-routed EP)" if args.model == "moe_bert"
-                 else "BERT-base")
+        label = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",
+                 "gpt_base": "GPT-base causal LM"}.get(args.model,
+                                                       "BERT-base MLM")
         print(json.dumps({
-            "metric": f"{label} MLM train-step throughput "
+            "metric": f"{label} train-step throughput "
                       "(GSPMD, eval off timed path)",
             "value": round(result["tokens_per_sec_per_chip"], 1),
             "unit": "tokens/sec/chip",

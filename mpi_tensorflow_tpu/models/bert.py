@@ -95,6 +95,10 @@ class BertMlm:
     mesh: Optional[Any] = None            # when set, activations/attention are
     rules: Optional[dict] = None          # sharded per the rule table
     use_flash: bool = True                # Pallas flash kernel on TPU
+    causal: bool = False                  # autoregressive mask everywhere
+                                          # (models/gpt.py sets True) —
+                                          # threaded through dense/ring/
+                                          # Ulysses/flash alike
 
     # ---------------- init ----------------
 
@@ -173,6 +177,7 @@ class BertMlm:
         it; otherwise the Pallas flash kernel on TPU (falls back to dense
         when shapes/platform don't allow it)."""
         on_tpu = jax.devices()[0].platform == "tpu"
+        causal = self.causal
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             specs = P("data" if self.mesh.shape.get("data", 1) > 1 else None,
                       "model" if self.mesh.shape.get("model", 1) > 1 else None,
@@ -189,14 +194,16 @@ class BertMlm:
                         from mpi_tensorflow_tpu.ops import \
                             flash_attention as fa
 
-                        if fa.kernel_supported(jnp.dtype(q.dtype).name):
+                        if fa.kernel_supported(jnp.dtype(q.dtype).name,
+                                               causal):
                             def inner_attn(q, k, v, causal=False,
                                            scale=None):
                                 return fa.flash_attention(q, k, v, causal,
                                                           scale)
                     return ulysses.ulysses_attention(q, k, v, "seq",
+                                                     causal=causal,
                                                      inner=inner_attn)
-                return ring.ring_attention(q, k, v, "seq")
+                return ring.ring_attention(q, k, v, "seq", causal=causal)
 
             # check_vma=False: pallas_call (the flash inner) cannot declare
             # varying-mesh-axes metadata on its outputs
@@ -209,9 +216,9 @@ class BertMlm:
             # back to XLA attention instead of failing the train step)
             from mpi_tensorflow_tpu.ops import flash_attention as fa
 
-            if fa.kernel_supported(jnp.dtype(q.dtype).name):
-                return fa.flash_attention(q, k, v)
-        return ring.dense_attention(q, k, v)
+            if fa.kernel_supported(jnp.dtype(q.dtype).name, causal):
+                return fa.flash_attention(q, k, v, causal)
+        return ring.dense_attention(q, k, v, causal=causal)
 
     def _mlp_block(self, lp, h, idx: int):
         """Position-wise MLP for layer ``idx`` -> (out, aux_loss).  The

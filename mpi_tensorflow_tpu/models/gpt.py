@@ -1,0 +1,56 @@
+"""Decoder-only causal LM (GPT-style) — the autoregressive family.
+
+The reference has no transformer at all; BASELINE.json's directed scale-out
+stops at BERT-base MLM.  This family demonstrates the framework's
+generality beyond the directed set: the SAME encoder blocks, sharding
+rules, attention kernels (causal flash / causal ring / causal Ulysses),
+loss machinery (chunked CE), optimizer, loops, and checkpointing drive an
+autoregressive LM — only the attention mask and the loss targets change.
+
+Implementation: subclasses ``BertMlm`` with ``causal=True`` (the mask is
+threaded through BertMlm._attention's dense/ring/Ulysses/flash paths — one
+implementation, no copied override) and
+- next-token loss: CE of position t against token t+1, over ALL positions
+  (no mask packing — every position carries loss), using the same chunked
+  online-logsumexp CE so (B, S, V) logits never materialize;
+- untied LM head option is intentionally omitted: weight tying matches the
+  MLM family and keeps vocab-parallel TP identical.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from mpi_tensorflow_tpu.models import bert as bert_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class CausalLm(bert_lib.BertMlm):
+    """GPT-style causal LM on the shared transformer stack."""
+    causal: bool = True
+
+    def loss(self, params, model_state, batch, labels=None, *, rng=None,
+             train: bool = False):
+        """Next-token CE.  ``batch``: dict with ``tokens`` (B, S) (or the
+        raw (B, S) int array); ``labels`` is ignored — targets are the
+        inputs shifted left, with the final position unsupervised."""
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        h, aux = self._encode_aux(params, tokens, train=train, rng=rng)
+        t = self.head_hidden(params, h)
+        targets = jnp.concatenate(
+            [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+        ce = self._ce(params, t, targets)                       # (B, S)
+        w = jnp.ones_like(ce).at[:, -1].set(0.0)                # drop last
+        loss = jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
+        return loss + self._aux_weight() * aux, model_state
+
+    def _use_chunked_ce(self) -> bool:
+        # every position carries loss (no mask packing), so the chunked CE
+        # is the memory-safe default unless the vocab is TP-sharded
+        if self.cfg.ce_impl == "dense":
+            return False
+        if self.cfg.ce_impl == "chunked":
+            return True
+        return self.mesh is None or self.mesh.shape.get("model", 1) == 1

@@ -378,12 +378,14 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
 # ---------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=8)
-def kernel_supported(dtype_name: str = "bfloat16") -> bool:
-    """One-time probe per dtype: do the fwd+bwd kernels compile for this
-    backend's Mosaic?  Model code gates on this (passing the dtype it will
-    actually run) so a toolchain regression degrades to the XLA attention
-    paths instead of killing the training step.  The probe shape fixes
-    D=64/S=128; other head dims share the same Mosaic surface."""
+def kernel_supported(dtype_name: str = "bfloat16",
+                     causal: bool = False) -> bool:
+    """One-time probe per (dtype, causal): do the fwd+bwd kernels compile
+    for this backend's Mosaic?  Model code gates on this (passing the dtype
+    and mask mode it will actually run) so a toolchain regression degrades
+    to the XLA attention paths instead of killing the training step.  The
+    probe shape fixes D=64/S=128; other head dims share the same Mosaic
+    surface."""
     import jax as _jax
 
     try:
@@ -392,13 +394,15 @@ def kernel_supported(dtype_name: str = "bfloat16") -> bool:
         q = jnp.zeros((1, 1, 128, 64), jnp.dtype(dtype_name))
 
         def f(q, k, v):
-            return jnp.sum(flash_attention(q, k, v).astype(jnp.float32))
+            return jnp.sum(
+                flash_attention(q, k, v, causal).astype(jnp.float32))
 
         _jax.jit(_jax.grad(f, argnums=(0, 1, 2))).lower(q, q, q).compile()
         return True
     except Exception as e:   # noqa: BLE001 — any compile failure disables
         print(f"[flash_attention] Pallas kernel probe failed for "
-              f"{dtype_name}; falling back to XLA attention ({e!r})")
+              f"{dtype_name} (causal={causal}); falling back to XLA "
+              f"attention ({e!r})")
         return False
 
 
