@@ -51,7 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", default=d.data_dir)
     p.add_argument("--model", default=d.model,
                    choices=["mnist_cnn", "resnet20", "resnet50", "bert_base",
-                            "moe_bert"])
+                            "moe_bert", "gpt_base"])
     p.add_argument("--dataset", default=d.dataset,
                    choices=["mnist", "cifar10", "imagenet_synthetic",
                             "mlm_synthetic"])
@@ -73,6 +73,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer layers (jax.checkpoint): "
                         "trade recompute FLOPs for peak activation HBM")
+    p.add_argument("--max-restarts", type=int, default=0,
+                   help="elastic recovery: restart from the latest "
+                        "checkpoint after transient infrastructure "
+                        "failures (train/elastic.py; pair with "
+                        "--checkpoint-dir)")
     p.add_argument("--prefetch", choices=["auto", "native", "thread", "off"],
                    default=d.prefetch,
                    help="background window assembly for the fused loop "
@@ -124,15 +129,33 @@ def main(argv=None) -> int:
 
     from mpi_tensorflow_tpu.utils import profiling
 
-    with profiling.trace(args.profile_dir):
-        if config.model in ("bert_base", "moe_bert"):
+    def run_once():
+        if config.model in ("bert_base", "moe_bert", "gpt_base"):
             from mpi_tensorflow_tpu.train import mlm_loop
 
-            mlm_loop.train_mlm(config)
-        else:
-            from mpi_tensorflow_tpu.train import loop
+            return mlm_loop.train_mlm(config)
+        from mpi_tensorflow_tpu.train import loop
 
-            loop.train(config)
+        return loop.train(config)
+
+    if args.max_restarts > 0 and not config.checkpoint_dir:
+        raise SystemExit(
+            "--max-restarts needs --checkpoint-dir: without checkpoints a "
+            "restart would silently re-train from step 0")
+
+    with profiling.trace(args.profile_dir):
+        if args.max_restarts > 0:
+            from mpi_tensorflow_tpu.train import elastic
+
+            def on_restart(i, e):
+                # retries resume from the latest committed checkpoint
+                config.resume = True
+
+            elastic.run_with_recovery(run_once,
+                                      max_restarts=args.max_restarts,
+                                      on_restart=on_restart)
+        else:
+            run_once()
     return 0
 
 

@@ -330,6 +330,12 @@ class BertMlm:
 
     # ---------------- loss ----------------
 
+    def _packs_positions(self) -> bool:
+        """Whether the loss packs masked positions before the head (the MLM
+        families).  The causal family computes CE at every position and
+        overrides this to False."""
+        return self.cfg.ce_positions == "masked"
+
     def _use_chunked_ce(self) -> bool:
         if self.cfg.ce_impl == "dense":
             return False
@@ -340,7 +346,7 @@ class BertMlm:
         # for full-position logits, unless the vocab axis is TP-sharded
         # (then dense logits are already sharded V/tp per device and GSPMD
         # places the logsumexp collectives)
-        if self.cfg.ce_positions == "masked":
+        if self._packs_positions():
             return False
         return self.mesh is None or self.mesh.shape.get("model", 1) == 1
 

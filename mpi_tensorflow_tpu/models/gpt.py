@@ -46,11 +46,5 @@ class CausalLm(bert_lib.BertMlm):
         loss = jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
         return loss + self._aux_weight() * aux, model_state
 
-    def _use_chunked_ce(self) -> bool:
-        # every position carries loss (no mask packing), so the chunked CE
-        # is the memory-safe default unless the vocab is TP-sharded
-        if self.cfg.ce_impl == "dense":
-            return False
-        if self.cfg.ce_impl == "chunked":
-            return True
-        return self.mesh is None or self.mesh.shape.get("model", 1) == 1
+    def _packs_positions(self) -> bool:
+        return False   # every position carries loss — no mask packing

@@ -1,0 +1,90 @@
+"""Elastic recovery: restart training from the latest checkpoint after a
+transient failure.
+
+The reference has no failure handling — any rank death kills the MPI job
+and all progress (SURVEY.md §5 failure row).  The TPU-native recovery
+story has three layers:
+
+1. **Graceful preemption** (train/preemption.py + ckpt_hooks.py): SIGTERM
+   -> multi-host-agreed stop -> durable checkpoint -> clean exit.
+2. **Crash durability** (train/checkpoint.py): trace-cadence async saves
+   mean at most ``log_every`` steps are lost to a hard kill; the sharded
+   format's meta.json commit marker makes torn writes invisible to
+   ``latest_step``.
+3. **Restart supervision** (this module): ``run_with_recovery`` re-invokes
+   the training entry point after a *transient* failure (device loss,
+   distributed-init hiccup, preemption eviction), resuming from the latest
+   committpoint.  Mesh-shape changes across restarts are supported by
+   ``restore_sharded`` (a job evicted from 8 chips can resume on 4).
+
+The supervisor deliberately re-raises on non-transient errors (ValueError
+etc. — a config bug restarted forever is a worse failure mode) and bounds
+restart count.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Iterable, Optional, Tuple
+
+# error types that MAY indicate transient infrastructure failure; jax
+# surfaces device loss / RPC failures as RuntimeError
+# (jaxlib.xla_extension.XlaRuntimeError subclasses it) — is_transient()
+# additionally inspects the message so deterministic RuntimeErrors
+# (compile OOM, shape bugs) fail fast instead of being retried
+TRANSIENT_ERRORS: Tuple[type, ...] = (RuntimeError, OSError, ConnectionError)
+
+_TRANSIENT_MARKERS = ("device_lost", "device lost", "unavailable",
+                      "aborted", "preempt", "connection", "socket",
+                      "deadline", "heartbeat", "simulated")
+_PERMANENT_MARKERS = ("resource_exhausted", "out of memory", "oom",
+                      "invalid_argument", "unimplemented", "failed_precond")
+
+
+def is_transient(e: BaseException) -> bool:
+    """Worth retrying?  OS/connection errors yes; RuntimeErrors only when
+    the message looks like infrastructure (device loss / RPC / preemption)
+    rather than a deterministic program failure (OOM, invalid argument)."""
+    if isinstance(e, (OSError, ConnectionError)):
+        return True
+    msg = str(e).lower()
+    if any(m in msg for m in _PERMANENT_MARKERS):
+        return False
+    return any(m in msg for m in _TRANSIENT_MARKERS)
+
+
+def run_with_recovery(train_fn: Callable[[], Any], *,
+                      max_restarts: int = 3,
+                      backoff_seconds: float = 5.0,
+                      transient: Iterable[type] = TRANSIENT_ERRORS,
+                      is_transient_fn: Callable[[BaseException],
+                                                bool] = is_transient,
+                      on_restart: Optional[Callable[[int, BaseException],
+                                                    None]] = None) -> Any:
+    """Run ``train_fn`` (a zero-arg closure over a --resume-enabled config),
+    restarting it after transient failures.
+
+    ``train_fn`` must be idempotent-from-checkpoint: constructed so each
+    invocation resumes from the latest committed checkpoint (the loops'
+    ``config.resume`` path).  ``on_restart(attempt, error)`` is the hook
+    for runtime re-initialization before the retry.  Non-transient
+    exceptions propagate immediately; the restart budget re-raises the
+    ORIGINAL exception (no type laundering).
+    """
+    transient = tuple(transient)
+    attempt = 0
+    while True:
+        try:
+            return train_fn()
+        except transient as e:
+            if not is_transient_fn(e):
+                raise
+            attempt += 1
+            if attempt > max_restarts:
+                print(f"[elastic] giving up after {max_restarts} restarts")
+                raise
+            print(f"[elastic] transient failure ({e!r}); restart "
+                  f"{attempt}/{max_restarts} in {backoff_seconds:.0f}s")
+            if on_restart is not None:
+                on_restart(attempt, e)
+            time.sleep(backoff_seconds)

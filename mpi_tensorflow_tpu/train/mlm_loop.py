@@ -51,6 +51,10 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         from mpi_tensorflow_tpu.models import moe
 
         model = moe.MoeBertMlm(bert_cfg, mesh=mesh)
+    elif config.model == "gpt_base":
+        from mpi_tensorflow_tpu.models import gpt
+
+        model = gpt.CausalLm(bert_cfg, mesh=mesh)
     elif mesh.shape.get("pipe", 1) > 1:
         import dataclasses as dc
 
@@ -93,15 +97,25 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     if verbose:
         logs.session_start(meshlib.process_index())
 
+    causal = getattr(model, "causal", False)
+
     def masked_error(s) -> float:
+        """Held-out error %: masked-position prediction error for the MLM
+        families; next-token prediction error (position t predicts t+1)
+        for the causal family."""
         errs, tot = 0, 0
         for i in range(0, min(test_n, 4 * b), b):
             tok = gspmd.shard_batch(ts_tokens[i:i + b], mesh)
             logits = np.asarray(eval_step(s, tok))
             pred = logits.argmax(-1)
-            m = ts_mask[i:i + b]
-            errs += int(((pred != ts_targets[i:i + b]) & m).sum())
-            tot += int(m.sum())
+            if causal:
+                tgt = np.asarray(ts_tokens[i:i + b])
+                errs += int((pred[:, :-1] != tgt[:, 1:]).sum())
+                tot += int(np.prod(tgt[:, 1:].shape))
+            else:
+                m = ts_mask[i:i + b]
+                errs += int(((pred != ts_targets[i:i + b]) & m).sum())
+                tot += int(m.sum())
         return 100.0 * errs / max(tot, 1)
 
     pending = 0

@@ -1,0 +1,104 @@
+"""Elastic recovery supervisor + end-to-end restart-resumes-training."""
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.config import Config
+from mpi_tensorflow_tpu.data import mnist
+from mpi_tensorflow_tpu.train import elastic, loop
+
+pytestmark = pytest.mark.quick
+
+
+class TestSupervisor:
+    def test_restarts_on_transient_then_succeeds(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("device lost")
+            return "done"
+
+        restarts = []
+        out = elastic.run_with_recovery(
+            fn, max_restarts=5, backoff_seconds=0.0,
+            on_restart=lambda i, e: restarts.append(i))
+        assert out == "done" and len(calls) == 3 and restarts == [1, 2]
+
+    def test_non_transient_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise ValueError("config bug")
+
+        with pytest.raises(ValueError):
+            elastic.run_with_recovery(fn, backoff_seconds=0.0)
+        assert len(calls) == 1
+
+    def test_gives_up_after_budget_reraising_original(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError("UNAVAILABLE: flaky forever")
+
+        with pytest.raises(RuntimeError, match="flaky forever"):
+            elastic.run_with_recovery(fn, max_restarts=2,
+                                      backoff_seconds=0.0)
+        assert len(calls) == 3   # initial + 2 restarts
+
+    def test_deterministic_runtime_error_fails_fast(self):
+        """RESOURCE_EXHAUSTED (OOM) must not be retried."""
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise RuntimeError("RESOURCE_EXHAUSTED: out of memory")
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            elastic.run_with_recovery(fn, max_restarts=5,
+                                      backoff_seconds=0.0)
+        assert len(calls) == 1
+
+
+@pytest.mark.usefixtures("mesh8")
+class TestEndToEnd:
+    def test_crash_restart_resumes_from_checkpoint(self, mesh8, mnist_dir,
+                                                   tmp_path):
+        """A mid-run 'device loss' restarts training, which resumes from
+        the latest async checkpoint instead of step 0."""
+        splits = mnist.load_splits(mnist_dir, num_shards=8, train_n=1200,
+                                   test_n=256)
+        boom = [True]
+        seen_starts = []
+
+        def train_full():
+            cfg = Config(epochs=2, batch_size=8, log_every=10, seed=1,
+                         checkpoint_dir=str(tmp_path), resume=True,
+                         fused_steps=1)
+            return loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+
+        def flaky():
+            if boom[0]:
+                # first attempt: a short prefix run leaves checkpoints
+                # behind, then the 'device loss' fires
+                cfg = Config(epochs=1, batch_size=8, log_every=10, seed=1,
+                             checkpoint_dir=str(tmp_path), fused_steps=1)
+                loop.train(cfg, splits=splits, mesh=mesh8, verbose=False)
+                boom[0] = False
+                raise RuntimeError("DEVICE_LOST: simulated")
+            from mpi_tensorflow_tpu.train import checkpoint
+
+            seen_starts.append(checkpoint.latest_step(str(tmp_path)))
+            return train_full()
+
+        res = elastic.run_with_recovery(flaky, max_restarts=2,
+                                        backoff_seconds=0.0)
+        assert np.isfinite(res.final_test_error)
+        # the retry found a committed checkpoint to resume from
+        assert seen_starts and seen_starts[0] is not None \
+            and seen_starts[0] > 0
+        # and the resumed run's history starts past that step
+        assert res.history[0][0] > seen_starts[0]
