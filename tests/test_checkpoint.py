@@ -106,6 +106,22 @@ class TestRoundTrip:
         assert checkpoint.latest_step(str(tmp_path)) == 5
         saver.close()
 
+    def test_restore_latest_prefers_sharded_format(self, tmp_path):
+        """restore_latest dispatches per format: npz-only steps restore via
+        restore(), sharded steps via restore_sharded()."""
+        model = cnn.MnistCnn()
+        st = step.init_state(model, jax.random.key(1))
+        checkpoint.save(str(tmp_path / "ckpt_1"), st, step=1)
+        checkpoint.save_sharded(str(tmp_path / "ckpt_2"), st, step=2)
+        assert checkpoint.latest_step(str(tmp_path)) == 2
+        template = step.init_state(model, jax.random.key(9))
+        st2, meta2 = checkpoint.restore_latest(str(tmp_path), template, 2)
+        assert meta2["step"] == 2
+        st1, meta1 = checkpoint.restore_latest(str(tmp_path), template, 1)
+        assert meta1["step"] == 1
+        for a, b in zip(jax.tree.leaves(st1), jax.tree.leaves(st2)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_mismatch_raises(self, tmp_path):
         model = cnn.MnistCnn()
         st = step.init_state(model, jax.random.key(1))

@@ -55,6 +55,46 @@ class TestHostSharding:
         assert max(seen) == t - 1
 
 
+class TestAgreedStop:
+    def test_stop_agreed_any_host_wins(self, monkeypatch, tmp_path):
+        """A SIGTERM observed on ANY host stops every host at the same
+        trace point (simulated via patched process_count/allgather)."""
+        import jax
+        import numpy as np
+
+        from jax.experimental import multihost_utils
+        from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
+
+        hooks = CheckpointHooks(str(tmp_path), verbose=False)
+        assert hooks.guard is not None
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        # some OTHER host observed the signal; ours did not
+        monkeypatch.setattr(
+            multihost_utils, "process_allgather",
+            lambda x: np.asarray([[False], [True], [False], [False]]))
+        assert not hooks.guard.should_stop
+        assert hooks.stop_agreed(10) is True
+        # the agreement also marks the local guard so the exit path prints
+        # a reason and later checks short-circuit
+        assert hooks.guard.should_stop
+        hooks.close()
+
+    def test_stop_now_is_single_host_only(self, monkeypatch, tmp_path):
+        """Per-step local stop must NOT fire multi-host (a lone host
+        leaving the loop would deadlock the pod's collectives)."""
+        import jax
+
+        from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
+
+        hooks = CheckpointHooks(str(tmp_path), verbose=False)
+        hooks.guard.request_stop("test")
+        monkeypatch.setattr(jax, "process_count", lambda: 1)
+        assert hooks.stop_now(5) is True
+        monkeypatch.setattr(jax, "process_count", lambda: 4)
+        assert hooks.stop_now(5) is False
+        hooks.close()
+
+
 class TestLoudInitFailure:
     def test_explicit_coordinator_failure_raises(self, monkeypatch):
         """A configured-but-broken multi-host launch must raise, not
