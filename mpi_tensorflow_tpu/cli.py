@@ -73,6 +73,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--remat", action="store_true",
                    help="rematerialize transformer layers (jax.checkpoint): "
                         "trade recompute FLOPs for peak activation HBM")
+    p.add_argument("--text-file", default=None,
+                   help="train the LM families on a local text file "
+                        "(byte-level tokenizer, data/corpus.py) instead of "
+                        "the synthetic stream")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="elastic recovery: restart from the latest "
                         "checkpoint after transient infrastructure "
@@ -110,7 +114,7 @@ def config_from_args(args) -> Config:
         early_stop_patience=args.early_stop_patience,
         sync=args.sync, seed=args.seed, data_dir=args.data_dir,
         model=args.model, dataset=args.dataset,
-        mesh_shape=parse_mesh(args.mesh),
+        mesh_shape=parse_mesh(args.mesh), text_file=args.text_file,
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         precision=args.precision, grad_accum=args.grad_accum,
         prefetch=args.prefetch, remat=args.remat,
@@ -122,6 +126,19 @@ def config_from_args(args) -> Config:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     config = config_from_args(args)
+
+    # flag-combination guards run BEFORE any jax/backend touch (fail fast,
+    # no device init on a doomed invocation)
+    if args.max_restarts > 0 and not config.checkpoint_dir:
+        raise SystemExit(
+            "--max-restarts needs --checkpoint-dir: without checkpoints a "
+            "restart would silently re-train from step 0")
+    if config.text_file and config.model not in ("bert_base", "moe_bert",
+                                                 "gpt_base"):
+        raise SystemExit(
+            f"--text-file applies to the language-model families "
+            f"(bert_base, moe_bert, gpt_base); --model {config.model} "
+            f"would silently ignore it")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 
@@ -137,11 +154,6 @@ def main(argv=None) -> int:
         from mpi_tensorflow_tpu.train import loop
 
         return loop.train(config)
-
-    if args.max_restarts > 0 and not config.checkpoint_dir:
-        raise SystemExit(
-            "--max-restarts needs --checkpoint-dir: without checkpoints a "
-            "restart would silently re-train from step 0")
 
     with profiling.trace(args.profile_dir):
         if args.max_restarts > 0:

@@ -61,6 +61,9 @@ class Config:
     remat: bool = False           # transformer-layer rematerialization
                                   # (jax.checkpoint): recompute activations
                                   # in the backward pass to cut peak HBM
+    text_file: Optional[str] = None  # real-text corpus for the LM families
+                                  # (data/corpus.py byte-level tokenizer);
+                                  # None = the synthetic stream
     prefetch: str = "auto"        # window-assembly prefetch for the fused
                                   # loop: "auto" (native C++ worker when
                                   # built, else Python thread), "native",

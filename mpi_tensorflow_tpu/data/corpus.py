@@ -1,0 +1,86 @@
+"""Real-text corpus loading for the language-model families.
+
+The reference loads exactly one dataset (MNIST idx files, mpipy.py:185-229);
+the framework's LM families (BERT-MLM, MoE, causal LM) additionally accept
+any local text file — tokenized offline with a self-contained byte-level
+tokenizer, so no downloads, vocab files, or external tokenizer packages are
+needed (zero-egress friendly).
+
+Byte-level scheme: ids 0-4 are specials (0 pad, 4 the MLM mask token,
+matching data/synthetic.py), bytes map to 5..260 — vocab 261.  Real BERT
+vocabularies drop in by re-tokenizing and raising ``vocab_size``; every
+downstream component (chunked CE, vocab-parallel TP) is vocab-size-generic.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+BYTE_VOCAB = 261          # 5 specials + 256 byte values
+PAD, MASK_TOKEN = 0, 4
+_BYTE_OFFSET = 5
+
+
+def encode_bytes(text: bytes | str) -> np.ndarray:
+    """Byte-level token ids (1-D int32)."""
+    if isinstance(text, str):
+        text = text.encode("utf-8")
+    return np.frombuffer(text, np.uint8).astype(np.int32) + _BYTE_OFFSET
+
+
+def decode_bytes(ids: np.ndarray) -> bytes:
+    b = np.asarray(ids, np.int64) - _BYTE_OFFSET
+    return b[(b >= 0) & (b < 256)].astype(np.uint8).tobytes()
+
+
+def sequences_from_file(path: str, *, seq_len: int,
+                        max_sequences: int | None = None) -> np.ndarray:
+    """Tokenize a text file into (N, seq_len) int32 rows (tail dropped —
+    static shapes for jit, like the reference's size truncation,
+    mpipy.py:211-213)."""
+    with open(path, "rb") as f:
+        ids = encode_bytes(f.read())
+    n = len(ids) // seq_len
+    if max_sequences is not None:
+        n = min(n, max_sequences)
+    if n == 0:
+        raise ValueError(f"{path}: shorter than one sequence ({seq_len})")
+    return ids[:n * seq_len].reshape(n, seq_len)
+
+
+def mlm_from_tokens(tokens: np.ndarray, *, mask_rate: float = 0.15,
+                    mask_token: int = MASK_TOKEN, seed: int = 0):
+    """BERT-style masking over a (N, S) token grid.
+
+    80% of selected positions -> mask token, 10% -> random id, 10% kept
+    (the original BERT recipe); returns ``(inputs, targets, mask)`` in the
+    same layout as data/synthetic.mlm_batches.
+    """
+    rng = np.random.default_rng(seed)
+    tokens = np.asarray(tokens, np.int32)
+    mask = rng.random(tokens.shape) < mask_rate
+    r = rng.random(tokens.shape)
+    inputs = tokens.copy()
+    inputs[mask & (r < 0.8)] = mask_token
+    rand_pos = mask & (r >= 0.8) & (r < 0.9)
+    # replacements drawn over the FULL byte vocab — content-independent
+    # masking distribution
+    inputs[rand_pos] = rng.integers(_BYTE_OFFSET, BYTE_VOCAB,
+                                    size=int(rand_pos.sum()))
+    return inputs, tokens, mask
+
+
+def load_mlm(path: str, *, seq_len: int = 128, mask_rate: float = 0.15,
+             seed: int = 0, max_sequences: int | None = None):
+    """Text file -> masked-LM arrays ``(inputs, targets, mask)``."""
+    toks = sequences_from_file(path, seq_len=seq_len,
+                               max_sequences=max_sequences)
+    return mlm_from_tokens(toks, mask_rate=mask_rate, seed=seed)
+
+
+def load_causal(path: str, *, seq_len: int = 128,
+                max_sequences: int | None = None) -> np.ndarray:
+    """Text file -> (N, S) token rows for the causal family (targets are
+    the inputs shifted — models/gpt.py derives them)."""
+    return sequences_from_file(path, seq_len=seq_len,
+                               max_sequences=max_sequences)

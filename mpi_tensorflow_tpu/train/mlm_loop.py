@@ -82,15 +82,38 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     if config.resume:
         state, start_step = hooks.resume(state)
 
-    tokens, targets, mask = synthetic.mlm_batches(
-        train_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
-        seed=config.seed)
-    ts_tokens, ts_targets, ts_mask = synthetic.mlm_batches(
-        test_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
-        seed=config.seed + 1)
+    if getattr(config, "text_file", None):
+        # real text via the byte-level tokenizer (data/corpus.py); the
+        # trailing rows become the held-out split
+        from mpi_tensorflow_tpu.data import corpus
+
+        if getattr(model, "causal", False):
+            rows = corpus.load_causal(config.text_file, seq_len=seq_len)
+            inp, tgt_all = rows, rows
+            msk = np.ones(rows.shape, bool)
+        else:
+            inp, tgt_all, msk = corpus.load_mlm(
+                config.text_file, seq_len=seq_len, seed=config.seed)
+        n_test = max(len(inp) // 10, 1)
+        train_n, test_n = len(inp) - n_test, n_test
+        tokens, targets, mask = (inp[:train_n], tgt_all[:train_n],
+                                 msk[:train_n])
+        ts_tokens, ts_targets, ts_mask = (inp[train_n:], tgt_all[train_n:],
+                                          msk[train_n:])
+    else:
+        tokens, targets, mask = synthetic.mlm_batches(
+            train_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
+            seed=config.seed)
+        ts_tokens, ts_targets, ts_mask = synthetic.mlm_batches(
+            test_n, seq_len=seq_len, vocab_size=bert_cfg.vocab_size,
+            seed=config.seed + 1)
 
     b = config.batch_size * mesh.shape.get("data", 1)
     num_steps = config.epochs * (train_n // b)
+    if num_steps == 0:
+        raise ValueError(
+            f"train split ({train_n} sequences) is smaller than one global "
+            f"batch ({b}); lower --batch-size or provide more data")
     rng = jax.random.key(config.seed + 2)
     timer = StepTimer(warmup_steps=1)
     history = []
@@ -99,22 +122,33 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
     causal = getattr(model, "causal", False)
 
+    def _eval_index_batches():
+        """(indices, valid) pairs: full (b,)-sized row-index batches over
+        the test split (jit needs static shapes).  Partial tails wrap-pad
+        to b rows, with ``valid`` marking how many are real — no trailing
+        rows are silently dropped, none double-counted."""
+        n = min(test_n, 4 * b)
+        for i in range(0, n, b):
+            take = min(b, n - i)
+            yield np.resize(np.arange(i, i + take), b), take
+
     def masked_error(s) -> float:
         """Held-out error %: masked-position prediction error for the MLM
         families; next-token prediction error (position t predicts t+1)
         for the causal family."""
         errs, tot = 0, 0
-        for i in range(0, min(test_n, 4 * b), b):
-            tok = gspmd.shard_batch(ts_tokens[i:i + b], mesh)
+        for idx, take in _eval_index_batches():
+            tok = gspmd.shard_batch(ts_tokens[idx], mesh)
             logits = np.asarray(eval_step(s, tok))
-            pred = logits.argmax(-1)
+            pred = logits.argmax(-1)[:take]
+            real = idx[:take]
             if causal:
-                tgt = np.asarray(ts_tokens[i:i + b])
+                tgt = np.asarray(ts_tokens[real])
                 errs += int((pred[:, :-1] != tgt[:, 1:]).sum())
                 tot += int(np.prod(tgt[:, 1:].shape))
             else:
-                m = ts_mask[i:i + b]
-                errs += int(((pred != ts_targets[i:i + b]) & m).sum())
+                m = ts_mask[real]
+                errs += int(((pred != ts_targets[real]) & m).sum())
                 tot += int(m.sum())
         return 100.0 * errs / max(tot, 1)
 
