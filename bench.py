@@ -281,6 +281,45 @@ def _record_baseline(section: str, result: dict) -> None:
     print(json.dumps({"recorded_baseline": result}))
 
 
+def _backend_reachable(timeout_s: int = 180) -> bool:
+    """Probe the accelerator backend in a SUBPROCESS with a hard timeout.
+
+    The axon tunnel can hang indefinitely inside the PJRT client init
+    (observed: hours) — a hang the parent cannot interrupt once
+    ``jax.devices()`` is entered.  Probing in a killable child turns that
+    failure mode into a parseable error line instead of a silent wedge.
+    Only meaningful when an axon backend is configured; otherwise True.
+    """
+    import subprocess
+
+    platforms = [p.strip() for p in
+                 os.environ.get("JAX_PLATFORMS", "").split(",") if p.strip()]
+    if platforms and not any(p in ("axon", "tpu") for p in platforms):
+        return True   # CPU/forced platforms initialize locally
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return True
+    # the probe child pays one full backend init that the parent repeats on
+    # success (~tens of seconds over the tunnel) — accepted: a bounded
+    # startup cost buys a bounded failure mode
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        if r.returncode == 0:
+            return True
+        global _PROBE_ERROR
+        _PROBE_ERROR = ("backend init failed (rc={}): {}".format(
+            r.returncode, r.stderr.decode(errors="replace")[-400:]))
+        return False
+    except subprocess.TimeoutExpired:
+        _PROBE_ERROR = (f"axon tunnel hung at PJRT client init (probe "
+                        f"timed out after {timeout_s}s)")
+        return False
+
+
+_PROBE_ERROR = ""
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--record-baseline", action="store_true",
@@ -323,6 +362,19 @@ def main(argv=None) -> int:
                          "off on the MXU-bound families (BERT/ResNet-50), "
                          "convergence pinned by tests/test_precision.py.")
     args = ap.parse_args(argv)
+
+    if not _backend_reachable():
+        # one parseable line beats an unbounded hang for whoever runs this
+        print(json.dumps({
+            "metric": "benchmark unavailable",
+            "value": 0,
+            "unit": "error",
+            "vs_baseline": None,
+            "detail": {"error": f"accelerator backend unreachable: "
+                                f"{_PROBE_ERROR}",
+                       "model": args.model, "mode": args.mode},
+        }))
+        return 1
 
     if args.mode == "allreduce":
         r = measure_allreduce(payload_mb=args.payload_mb,

@@ -1,9 +1,10 @@
 """Elastic recovery: restart training from the latest checkpoint after a
 transient failure.
 
-The reference has no failure handling — any rank death kills the MPI job
-and all progress (SURVEY.md §5 failure row).  The TPU-native recovery
-story has three layers:
+The reference has no failure handling — a failed download raises an
+undefined ``DownloadError`` NameError (mpipy.py:196-198) and any rank
+death kills the MPI job with all progress lost (SURVEY.md §5 failure
+row).  The TPU-native recovery story has three layers:
 
 1. **Graceful preemption** (train/preemption.py + ckpt_hooks.py): SIGTERM
    -> multi-host-agreed stop -> durable checkpoint -> clean exit.

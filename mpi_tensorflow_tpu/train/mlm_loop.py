@@ -124,9 +124,12 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
     def _eval_index_batches():
         """(indices, valid) pairs: full (b,)-sized row-index batches over
-        the test split (jit needs static shapes).  Partial tails wrap-pad
-        to b rows, with ``valid`` marking how many are real — no trailing
-        rows are silently dropped, none double-counted."""
+        the SAMPLED test window (jit needs static shapes).  The window is
+        capped at 4 global batches — held-out error is a sampled estimate
+        on large splits, keeping eval off the timed path cheap.  Within
+        the window a partial tail wrap-pads to b rows with ``valid``
+        marking how many are real, so no window row is dropped or
+        double-counted."""
         n = min(test_n, 4 * b)
         for i in range(0, n, b):
             take = min(b, n - i)
