@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Round-2/3 TPU measurement batch (BASELINE.md "Round-2 measurement plan").
+# Fire this the moment the axon tunnel responds; each step appends one JSON
+# line to MEASURE_LOG.jsonl.  Safe to re-run; bench.py fails fast with a
+# parseable error line if the tunnel is down.
+set -u
+cd "$(dirname "$0")/.."
+LOG=MEASURE_LOG.jsonl
+export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
+
+run() {
+  echo "### $* $(date -u +%FT%TZ)" >> "$LOG"
+  timeout 900 "$@" 2>/dev/null | tail -1 >> "$LOG"
+}
+
+# 0. kernel validation (memory: flash-kernel-probe-gating)
+echo "### kernel_supported probes $(date -u +%FT%TZ)" >> "$LOG"
+timeout 900 python -c "
+from mpi_tensorflow_tpu.ops.flash_attention import kernel_supported
+print({d: {c: kernel_supported(d, c) for c in (False, True)}
+       for d in ('bfloat16', 'float32')})" 2>/dev/null | tail -1 >> "$LOG"
+
+# 1. flagship BERT CE-variant sweep (config 5)
+run python bench.py --model bert_base --precision bf16
+run python bench.py --model bert_base --precision bf16 --ce chunked
+run python bench.py --model bert_base --precision bf16 --ce dense
+run python bench.py --model bert_base --precision bf16 --params-bf16
+
+# 2. ResNet-50 batch/remat sweep (config 4; target >= 2x 1328 img/s)
+run python bench.py --model resnet50 --precision bf16
+run python bench.py --model resnet50 --precision bf16 --batch-size 128 --remat
+run python bench.py --model resnet50 --precision bf16 --batch-size 256 --remat
+
+# 3. new families
+run python bench.py --model moe_bert --precision bf16
+run python bench.py --model gpt_base --precision bf16
+
+# 4. unchanged configs (re-record under today's tenancy)
+run python bench.py
+run python bench.py --model resnet20
+run python bench.py --mode allreduce
+
+echo "batch complete: $(date -u +%FT%TZ)  -> $LOG"
