@@ -177,3 +177,30 @@ class TestBertUlysses:
         lu = m_u.apply(params, tokens, train=False)
         np.testing.assert_allclose(np.asarray(lu), np.asarray(lr),
                                    rtol=2e-3, atol=2e-3)
+
+
+class TestLongContext:
+    def test_ulysses_flash_long_sequence(self):
+        """S=2048 over 8 shards with the Pallas flash kernel (interpret)
+        as the local attention — the intended long-context configuration."""
+        from mpi_tensorflow_tpu.ops import flash_attention as fa
+
+        seq_mesh = jax.make_mesh((8,), ("seq",))
+        rng = np.random.default_rng(2)
+        B, H, S, D = 1, 8, 2048, 16
+        mk = lambda: rng.normal(size=(B, H, S, D)).astype(np.float32) * 0.3
+        q, k, v = mk(), mk(), mk()
+
+        def inner(q, k, v, causal=False, scale=None):
+            return fa.flash_attention(q, k, v, causal, scale, 256, 256,
+                                      True)
+
+        f = jax.jit(jax.shard_map(
+            lambda q, k, v: ulysses.ulysses_attention(q, k, v, "seq",
+                                                      inner=inner),
+            mesh=seq_mesh, in_specs=(P(None, None, "seq"),) * 3,
+            out_specs=P(None, None, "seq"), check_vma=False))
+        got = np.asarray(f(q, k, v))
+        want = np.asarray(fa.blockwise_attention(
+            jnp.array(q), jnp.array(k), jnp.array(v), block_k=256))
+        np.testing.assert_allclose(got, want, rtol=3e-4, atol=3e-5)
