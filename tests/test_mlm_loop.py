@@ -24,6 +24,22 @@ class TestMlmLoop:
         # step 128 and keeps falling with more steps)
         assert res.final_error < 97.0, res.history
 
+    def test_pipe_mesh_end_to_end(self):
+        """--mesh pipe=4,data=2 routes to PipelinedBertMlm and trains
+        (dropout auto-disabled with a note, per mlm_loop)."""
+        import dataclasses
+
+        mesh = meshlib.make_mesh({"pipe": 4, "data": 2})
+        cfg = Config(epochs=10, batch_size=4, log_every=16, seed=1)
+        tiny = dataclasses.replace(bert.BERT_TINY, layers=4)
+        res = mlm_loop.train_mlm(cfg, bert_cfg=tiny, mesh=mesh, seq_len=32,
+                                 train_n=128, test_n=64,
+                                 learning_rate=3e-3, verbose=False)
+        assert np.isfinite(res.final_error)
+        # error must move off the 100% random plateau and keep falling
+        assert res.final_error < 99.0, res.history
+        assert res.history[-1][1] < res.history[0][1]
+
     def test_checkpoint_resume(self, tmp_path):
         """--checkpoint-dir/--resume work for the transformer loop (round-2
         gap: only the image loop checkpointed)."""
