@@ -1,0 +1,81 @@
+"""bench.py helpers: backend-probe gating and CLI flag validation."""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+import bench
+
+pytestmark = pytest.mark.quick
+
+
+class TestBackendProbeGate:
+    def test_cpu_platform_skips_probe(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        assert bench._backend_reachable() is True
+
+    def test_no_pool_skips_probe(self, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.delenv("PALLAS_AXON_POOL_IPS", raising=False)
+        assert bench._backend_reachable() is True
+
+    def test_comma_separated_axon_probes(self, monkeypatch):
+        """axon anywhere in a priority list must NOT bypass the probe."""
+        monkeypatch.setenv("JAX_PLATFORMS", "axon,cpu")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+        calls = []
+
+        import subprocess
+
+        class FakeDone:
+            returncode = 0
+            stderr = b""
+
+        monkeypatch.setattr(subprocess, "run",
+                            lambda *a, **k: calls.append(1) or FakeDone())
+        assert bench._backend_reachable() is True
+        assert calls, "probe was bypassed for a comma-separated platform list"
+
+    def test_probe_timeout_reports_hang(self, monkeypatch):
+        import subprocess
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+
+        def boom(*a, **k):
+            raise subprocess.TimeoutExpired(cmd="x", timeout=1)
+
+        monkeypatch.setattr(subprocess, "run", boom)
+        assert bench._backend_reachable(timeout_s=1) is False
+        assert "hung" in bench._PROBE_ERROR
+
+    def test_probe_failure_reports_stderr(self, monkeypatch):
+        import subprocess
+
+        monkeypatch.setenv("JAX_PLATFORMS", "axon")
+        monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+
+        class FakeFail:
+            returncode = 1
+            stderr = b"auth expired"
+
+        monkeypatch.setattr(subprocess, "run", lambda *a, **k: FakeFail())
+        assert bench._backend_reachable() is False
+        assert "auth expired" in bench._PROBE_ERROR
+
+
+class TestFlagValidation:
+    def test_params_bf16_requires_bf16(self):
+        with pytest.raises(SystemExit):
+            bench.main(["--model", "bert_base", "--params-bf16"])
+
+    def test_params_bf16_rejects_image_models(self):
+        with pytest.raises(SystemExit):
+            bench.main(["--model", "resnet20", "--precision", "bf16",
+                        "--params-bf16"])
+
+    def test_record_baseline_rejects_bf16(self):
+        with pytest.raises(SystemExit):
+            bench.main(["--record-baseline", "--precision", "bf16"])
