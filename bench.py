@@ -116,10 +116,21 @@ def measure_bert(batch_size: int, steps: int, precision: str,
                "mask": jax.device_put(mask.reshape(shape), sh)}
     labels = jax.device_put(tgts.reshape(shape), sh)
 
+    from mpi_tensorflow_tpu.ops import flash_attention as fa
+    from mpi_tensorflow_tpu.utils import engagement
+
+    engagement.reset()   # snapshot below reflects THIS trace only
     sec = _measure_scanned(multi, state, batches, labels, jax.random.key(1),
                            K, max(1, steps // K), warmup_calls=2)
+    dtype_name = jnp.dtype(bcfg.dtype).name
+    causal = model_name == "gpt_base"
     return {
         "model": model_name,
+        # which implementations the compiled step actually engaged — an
+        # XLA fallback must never masquerade as a kernel number (VERDICT r2)
+        "paths": engagement.snapshot(),
+        "flash_probe": {f"{dtype_name}/causal={causal}":
+                        fa.kernel_supported(dtype_name, causal)},
         "tokens_per_sec_per_chip": batch_size * seq_len / sec,
         "examples_per_sec_per_chip": batch_size / sec,
         "step_time_ms": sec * 1e3,

@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from mpi_tensorflow_tpu.parallel import ring, sharding_rules as rules_lib
+from mpi_tensorflow_tpu.utils import engagement
 
 
 @dataclasses.dataclass(frozen=True)
@@ -200,9 +201,13 @@ class BertMlm:
                                            scale=None):
                                 return fa.flash_attention(q, k, v, causal,
                                                           scale)
+                    engagement.record(
+                        "attention", "ulysses+flash" if inner_attn is not None
+                        else "ulysses+xla")
                     return ulysses.ulysses_attention(q, k, v, "seq",
                                                      causal=causal,
                                                      inner=inner_attn)
+                engagement.record("attention", "ring")
                 return ring.ring_attention(q, k, v, "seq", causal=causal)
 
             # check_vma=False: pallas_call (the flash inner) cannot declare
@@ -217,7 +222,9 @@ class BertMlm:
             from mpi_tensorflow_tpu.ops import flash_attention as fa
 
             if fa.kernel_supported(jnp.dtype(q.dtype).name, causal):
+                engagement.record("attention", "flash")
                 return fa.flash_attention(q, k, v, causal)
+        engagement.record("attention", "xla_dense")
         return ring.dense_attention(q, k, v, causal=causal)
 
     def _mlp_block(self, lp, h, idx: int):
@@ -356,9 +363,11 @@ class BertMlm:
         if self._use_chunked_ce():
             from mpi_tensorflow_tpu.ops import mlm_head
 
+            engagement.record("ce", f"chunked:{self.cfg.ce_chunk}")
             return mlm_head.tied_softmax_ce(
                 t, params["tok_emb"], params["mlm"]["out_b"], labels,
                 chunk=self.cfg.ce_chunk)
+        engagement.record("ce", "dense")
         logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
             + params["mlm"]["out_b"]
         logits = self._constrain(
@@ -380,6 +389,7 @@ class BertMlm:
         if self.cfg.ce_positions == "masked":
             from mpi_tensorflow_tpu.ops import mlm_head
 
+            engagement.record("ce_positions", "masked_packed")
             S = h.shape[1]
             cap = min(S, max(8, -(-int(self.cfg.ce_capacity_frac * S) // 8)
                              * 8))
@@ -389,6 +399,7 @@ class BertMlm:
             ce = self._ce(params, t, plabels)
             weights = w
         else:
+            engagement.record("ce_positions", "all")
             t = self.head_hidden(params, h)
             ce = self._ce(params, t, labels)
             weights = mask.astype(jnp.float32)

@@ -385,10 +385,22 @@ def kernel_supported(dtype_name: str = "bfloat16",
     and mask mode it will actually run) so a toolchain regression degrades
     to the XLA attention paths instead of killing the training step.  The
     probe shape fixes D=64/S=128; other head dims share the same Mosaic
-    surface."""
+    surface.
+
+    ``MPI_TF_TPU_DISABLE_FLASH=1`` force-disables the kernels (operator
+    kill switch; also the control arm for flash-vs-XLA A/B benches).
+    Checked inside the cached body, so it must be set before first use."""
+    import os as _os
+
     import jax as _jax
 
     try:
+        if _os.environ.get("MPI_TF_TPU_DISABLE_FLASH", "") not in ("", "0"):
+            import sys as _sys
+
+            print("[flash_attention] disabled via MPI_TF_TPU_DISABLE_FLASH",
+                  file=_sys.stderr)
+            return False
         if _jax.devices()[0].platform != "tpu":
             return False
         q = jnp.zeros((1, 1, 128, 64), jnp.dtype(dtype_name))
@@ -400,9 +412,11 @@ def kernel_supported(dtype_name: str = "bfloat16",
         _jax.jit(_jax.grad(f, argnums=(0, 1, 2))).lower(q, q, q).compile()
         return True
     except Exception as e:   # noqa: BLE001 — any compile failure disables
+        import sys as _sys
+
         print(f"[flash_attention] Pallas kernel probe failed for "
               f"{dtype_name} (causal={causal}); falling back to XLA "
-              f"attention ({e!r})")
+              f"attention ({e!r})", file=_sys.stderr)
         return False
 
 

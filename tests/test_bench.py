@@ -79,3 +79,18 @@ class TestFlagValidation:
     def test_record_baseline_rejects_bf16(self):
         with pytest.raises(SystemExit):
             bench.main(["--record-baseline", "--precision", "bf16"])
+
+class TestMeasureBertDetail:
+    def test_paths_and_probe_in_detail(self, monkeypatch):
+        """measure_bert's result must record which attention/CE paths the
+        compiled step engaged plus the kernel-probe verdict (VERDICT r2 #2:
+        an XLA fallback must never masquerade as a kernel number)."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_bert(batch_size=2, steps=2, precision="fp32",
+                               scan_steps=1, seq_len=32)
+        assert r["paths"]["attention"] == "xla_dense"   # CPU -> probe False
+        assert r["paths"]["ce_positions"] == "masked_packed"
+        assert "ce" in r["paths"]
+        assert r["flash_probe"] == {"float32/causal=False": False}
