@@ -84,6 +84,15 @@ def _norm_init(key, shape, stddev=0.02):
     return jax.random.normal(key, shape) * stddev
 
 
+def dropout_mask(x, rate: float, key):
+    """Inverted dropout: zero with prob ``rate``, scale survivors by
+    1/keep.  The single implementation shared by BertMlm's keyed streams
+    and the pipelined model's fold-derived keys."""
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(key, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0)
+
+
 def _layernorm(x, p, eps=1e-12):
     mu = jnp.mean(x, axis=-1, keepdims=True)
     var = jnp.var(x, axis=-1, keepdims=True)
@@ -265,10 +274,7 @@ class BertMlm:
                 return x
             if rng is None:
                 raise ValueError("dropout needs an rng in train mode")
-            keep = 1.0 - c.dropout
-            mask = jax.random.bernoulli(
-                jax.random.fold_in(rng, i), keep, x.shape)
-            return jnp.where(mask, x / keep, 0.0)
+            return dropout_mask(x, c.dropout, jax.random.fold_in(rng, i))
 
         def dropout(x):
             nonlocal drop_i

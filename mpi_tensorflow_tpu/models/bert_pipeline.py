@@ -14,7 +14,12 @@ backward pipeline (reverse ``ppermute`` hops) automatically.
 
 Composition: ``pipe x data`` (each data shard runs its own microbatch
 stream through the stages).  The loss-side machinery (masked-position
-packing, chunked CE) is inherited.
+packing, chunked CE) is inherited.  Dropout trains unmodified: the
+schedule hands each stage the index of the microbatch it is processing
+(parallel/pipeline.py ``with_mb_index``), and dropout keys are folded on
+(data shard, microbatch, global layer, site) so every microbatch draws
+independent masks — including under remat, which replays the same fold
+inputs and hence identical masks in the recomputation.
 
 Memory schedule: GPipe stores ~M microbatch boundary activations for the
 backward pipeline.  The 1F1B peak of O(P) in-flight activations is obtained
@@ -84,11 +89,19 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                                      for kk, vv in v.items()}
         return axes
 
-    def _plain_layer(self, lp, h):
+    def _plain_layer(self, lp, h, drop=None):
         """One encoder layer with no mesh constraints — runs inside the
         pipe ``shard_map`` where GSPMD annotations are unavailable.  Same
-        math as BertMlm's layer (dropout-free; see ``_encode_aux``)."""
+        math as BertMlm's layer.  ``drop``: ``None`` (eval / dropout off) or
+        a ``site -> key`` function yielding this layer's per-site dropout
+        keys (already folded on microbatch and global layer index)."""
         dt = self.cfg.dtype
+
+        def dropout(x, site):
+            if drop is None:
+                return x
+            return bert_lib.dropout_mask(x, self.cfg.dropout, drop(site))
+
         q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
             + lp["bq"].astype(dt)[None, :, None, :]
         k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
@@ -98,18 +111,38 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         a = ring.dense_attention(q, k, v)
         a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
             + lp["bo"].astype(dt)
-        h = _layernorm(h + a, lp["ln1"]).astype(dt)
+        h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)
         m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
                         + lp["b1"].astype(dt))
         m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
             + lp["b2"].astype(dt)
-        return _layernorm(h + m, lp["ln2"]).astype(dt)
+        return _layernorm(h + dropout(m, 1), lp["ln2"]).astype(dt)
 
-    def _stage(self, stage_params, x):
+    def _dropping(self, train: bool, rng) -> bool:
+        if not (train and self.cfg.dropout > 0.0):
+            return False
+        if rng is None:
+            raise ValueError("dropout needs an rng in train mode")
+        return True
+
+    def _stage(self, stage_params, x, rng=None, mb_idx=None,
+               stage_idx=None):
         """Run this stage's L/P layers sequentially (scan over the layer
-        dim of the stacked params)."""
-        def body(h, lp):
-            return self._plain_layer(lp, h), None
+        dim of the stacked params).  When ``rng`` is set, dropout keys are
+        folded on (microbatch, global layer, site) so every microbatch at
+        every layer draws an independent mask — and a remat recomputation
+        replays the identical mask (keys are pure functions of the fold
+        inputs)."""
+        Lp = jax.tree.leaves(stage_params)[0].shape[0]
+
+        def body(h, inp):
+            lp, li = inp
+            drop = None
+            if rng is not None:
+                gl = stage_idx * Lp + li      # global layer index
+                kb = jax.random.fold_in(jax.random.fold_in(rng, mb_idx), gl)
+                drop = lambda site: jax.random.fold_in(kb, site)  # noqa: E731
+            return self._plain_layer(lp, h, drop=drop), None
 
         if self.cfg.remat:
             # recompute stage activations in the backward pipeline: the
@@ -117,29 +150,30 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             # per tick instead of every layer's internals (the GPipe
             # activation-memory story)
             body = jax.checkpoint(body)
-        h, _ = lax.scan(body, x, stage_params)
+        h, _ = lax.scan(body, x, (stage_params, jnp.arange(Lp)))
         return h
 
     def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
         c = self.cfg
-        if train and c.dropout > 0.0:
-            raise NotImplementedError(
-                "PipelinedBertMlm does not support dropout yet — set "
-                "dropout=0.0 in the BertConfig")
+        dropping = self._dropping(train, rng)
         dt = c.dtype
         B, S = tokens.shape
         h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
-        h = _layernorm(h, params["emb_ln"]).astype(dt)
+        h = _layernorm(h, params["emb_ln"])
+        if dropping:
+            # embedding dropout (BertMlm's first site), on a stream index
+            # no in-stage fold chain can collide with
+            h = bert_lib.dropout_mask(h, c.dropout,
+                                      jax.random.fold_in(rng, 2 ** 30))
+        h = h.astype(dt)
         h = self._constrain(h, ("batch", "seq", "embed"))
 
         n_stages = self._num_stages
         if n_stages == 1:   # no pipe axis: plain sequential stack
-            def body(hh, lp):
-                return self._plain_layer(lp, hh), None
-
             flat = jax.tree.map(
                 lambda x: x.reshape((-1,) + x.shape[2:]), params["layers"])
-            h, _ = lax.scan(body, h, flat)
+            h = self._stage(flat, h, rng=rng if dropping else None,
+                            mb_idx=jnp.int32(0), stage_idx=jnp.int32(0))
             return h, jnp.zeros((), jnp.float32)
 
         M = self.num_microbatches
@@ -150,16 +184,28 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 f"{M} microbatches")
         h_spec = P("data" if dp > 1 else None)
 
-        def inner(stacked_local, hl):
+        def inner(stacked_local, hl, key):
             stage_params = jax.tree.map(lambda x: x[0], stacked_local)
             mb = hl.reshape((M, hl.shape[0] // M) + hl.shape[1:])
-            out = pipeline_lib.pipeline(
-                lambda p, x: self._stage(p, x), stage_params, mb, "pipe")
+            if dropping:
+                # decorrelate the data shards' masks too (each data shard
+                # pipelines a different slice of the global batch)
+                key = jax.random.fold_in(
+                    key, lax.axis_index("data") if dp > 1 else 0)
+                sidx = lax.axis_index("pipe")
+                out = pipeline_lib.pipeline(
+                    lambda p, x, mi: self._stage(p, x, rng=key, mb_idx=mi,
+                                                 stage_idx=sidx),
+                    stage_params, mb, "pipe", with_mb_index=True)
+            else:
+                out = pipeline_lib.pipeline(
+                    lambda p, x: self._stage(p, x), stage_params, mb, "pipe")
             return out.reshape(hl.shape)
 
+        key = rng if dropping else jax.random.key(0)
         h = jax.shard_map(
             inner, mesh=self.mesh,
-            in_specs=(P("pipe"), h_spec), out_specs=h_spec,
-            check_vma=False)(params["layers"], h)
+            in_specs=(P("pipe"), h_spec, P()), out_specs=h_spec,
+            check_vma=False)(params["layers"], h, key)
         h = self._constrain(h, ("batch", "seq", "embed"))
         return h, jnp.zeros((), jnp.float32)

@@ -26,13 +26,19 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def pipeline(stage_fn: Callable, stage_params: Any, microbatches,
-             axis: str = "pipe"):
+             axis: str = "pipe", with_mb_index: bool = False):
     """Run ``stage_fn(params, x) -> y`` as a P-stage pipeline.
 
     Inside ``shard_map``: ``stage_params`` is this shard's stage parameters,
     ``microbatches`` has shape (M, mb, ...) and must hold the SAME full set
     of microbatches on every shard (replicated over ``axis``); the result is
     the final stage's outputs, (M, mb, ...), valid on every shard.
+
+    ``with_mb_index=True`` calls ``stage_fn(params, x, mb_idx)`` where
+    ``mb_idx`` is the index of the microbatch this stage is processing at
+    the current tick (clipped to [0, M-1] during bubble ticks, whose outputs
+    are discarded anyway) — the hook stateful-per-microbatch ops (dropout
+    rng folding) need to decorrelate microbatches.
     """
     n_stages = lax.axis_size(axis)
     stage_idx = lax.axis_index(axis)
@@ -48,7 +54,12 @@ def pipeline(stage_fn: Callable, stage_params: Any, microbatches,
         feed_idx = jnp.clip(t, 0, m - 1)
         fed = jnp.where(stage_idx == 0,
                         microbatches[feed_idx].astype(state.dtype), state)
-        y = stage_fn(stage_params, fed)
+        if with_mb_index:
+            # at tick t, stage s works on microbatch t-s (pipeline skew)
+            y = stage_fn(stage_params, fed,
+                         jnp.clip(t - stage_idx, 0, m - 1))
+        else:
+            y = stage_fn(stage_params, fed)
         # last stage emits microbatch t-(P-1) when it is valid
         out_idx = t - (n_stages - 1)
         valid = (stage_idx == n_stages - 1) & (out_idx >= 0)

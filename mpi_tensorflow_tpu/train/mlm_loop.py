@@ -56,15 +56,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
         model = gpt.CausalLm(bert_cfg, mesh=mesh)
     elif mesh.shape.get("pipe", 1) > 1:
-        import dataclasses as dc
-
         from mpi_tensorflow_tpu.models import bert_pipeline
 
-        if bert_cfg.dropout:
-            if verbose:
-                print("[pipeline] dropout disabled (not yet supported "
-                      "through the pipe schedule)")
-            bert_cfg = dc.replace(bert_cfg, dropout=0.0)
         model = bert_pipeline.PipelinedBertMlm(bert_cfg, mesh=mesh)
     else:
         model = bert.BertMlm(bert_cfg, mesh=mesh)

@@ -25,13 +25,14 @@ class TestMlmLoop:
         assert res.final_error < 97.0, res.history
 
     def test_pipe_mesh_end_to_end(self):
-        """--mesh pipe=4,data=2 routes to PipelinedBertMlm and trains
-        (dropout auto-disabled with a note, per mlm_loop)."""
+        """--mesh pipe=4,data=2 routes to PipelinedBertMlm and trains the
+        flagship config unmodified — INCLUDING dropout (the round-2 silent
+        dropout-zeroing downgrade is gone)."""
         import dataclasses
 
         mesh = meshlib.make_mesh({"pipe": 4, "data": 2})
         cfg = Config(epochs=10, batch_size=4, log_every=16, seed=1)
-        tiny = dataclasses.replace(bert.BERT_TINY, layers=4)
+        tiny = dataclasses.replace(bert.BERT_TINY, layers=4, dropout=0.1)
         res = mlm_loop.train_mlm(cfg, bert_cfg=tiny, mesh=mesh, seq_len=32,
                                  train_n=128, test_n=64,
                                  learning_rate=3e-3, verbose=False)
