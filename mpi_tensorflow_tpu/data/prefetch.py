@@ -129,8 +129,19 @@ class ThreadPrefetcher:
                     raise RuntimeError(
                         "prefetch worker failed") from self._exc
                 if not self._thread.is_alive():
-                    raise RuntimeError(
-                        "prefetch worker died without producing a window")
+                    # the worker may have put its final item and exited
+                    # between our timeout and the liveness check — only a
+                    # truly empty queue means it died short
+                    try:
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        if self._exc is not None:   # died raising, just now
+                            raise RuntimeError(
+                                "prefetch worker failed") from self._exc
+                        raise RuntimeError(
+                            "prefetch worker died without producing a "
+                            "window") from None
         self._n -= 1
         return item
 

@@ -49,8 +49,14 @@ def _write_npz(path: str, arrays: dict, meta: dict) -> None:
     tmp = path + ".tmp.npz"
     np.savez(tmp, **arrays)
     os.replace(tmp, path + ".npz")
-    with open(path + ".json", "w") as f:
+    # the .json sidecar is the commit marker (written LAST, atomically):
+    # latest_step ignores a bare .npz, so a kill between the two writes
+    # falls back to the previous committed step instead of a
+    # FileNotFoundError at restore
+    tmpj = path + ".json.tmp"
+    with open(tmpj, "w") as f:
         json.dump(meta, f)
+    os.replace(tmpj, path + ".json")
 
 
 def save(path: str, state: Any, *, step: Optional[int] = None,
@@ -177,18 +183,32 @@ def _write_sharded(path: str, jobs: list, meta: dict) -> None:
     # all processes write shard files into the final directory; process 0
     # writes meta.json last — its presence is the commit marker (latest_step
     # ignores directories without it)
-    d = path + ".sharded"
+    _write_shard_files(path + ".sharded", jobs)
+    _barrier_and_commit(path + ".sharded", meta)
+
+
+def _write_shard_files(d: str, jobs: list) -> None:
     os.makedirs(d, exist_ok=True)
     for fname, arr in jobs:
         tmpf = os.path.join(d, fname + ".tmp")
         with open(tmpf, "wb") as f:
             np.save(f, arr)
         os.replace(tmpf, os.path.join(d, fname))
+
+
+def _barrier_and_commit(d: str, meta: dict) -> None:
+    """Cross-host barrier, then process 0 writes the commit marker.
+
+    The commit marker must not be written until EVERY host's shard files
+    are durable — otherwise a preemption between process 0's meta write and
+    a straggler's shard write leaves a checkpoint that latest_step()
+    reports committed but restore cannot read.
+
+    Multi-host, this is a DEVICE COLLECTIVE: it must run on the main
+    thread, in the same program-order slot on every process, never on a
+    worker thread racing the training step's collectives (per-host enqueue
+    order would diverge and deadlock the pod — see AsyncSaver)."""
     if jax.process_count() > 1:
-        # the commit marker must not be written until EVERY host's shard
-        # files are durable — otherwise a preemption between process 0's
-        # meta write and a straggler's shard write leaves a checkpoint that
-        # latest_step() reports committed but restore cannot read
         from jax.experimental import multihost_utils
 
         multihost_utils.sync_global_devices("ckpt_shards_written")
@@ -256,13 +276,27 @@ def restore_sharded(path: str, template: Any) -> tuple[Any, dict]:
 class AsyncSaver:
     """Background checkpoint writer: ``save()`` snapshots the state's
     addressable shards to host (synchronous — safe against buffer donation)
-    and hands the disk write to a worker thread.  At most one write is in
-    flight; a second ``save`` waits for the first (bounded memory).  Worker
-    errors re-raise on the next ``save``/``wait``."""
+    and hands the disk write to a worker thread.  ``save`` first joins any
+    write still in flight, so at most ONE host snapshot is live at a time
+    (the memory bound is one state copy, not two).  Worker errors re-raise
+    on the next ``save``/``wait``.
+
+    Multi-host, the sharded format's commit involves a cross-host barrier —
+    a device collective.  Collectives must be enqueued in the same program
+    order on every process; a barrier running on this worker thread would
+    race the main thread's train-step collectives and could deadlock the
+    pod.  The worker therefore writes ONLY shard files; the barrier +
+    meta.json commit run on the MAIN thread, inside the next ``save()`` or
+    ``wait()`` (both loop-synchronous call sites).  Consequence: a save is
+    durable-but-uncommitted until the next trace point or ``wait()`` — a
+    crash in that window resumes from the previous committed step.
+    Single-process runs commit on the worker (no collective involved), so
+    the checkpoint is committed as soon as the write finishes."""
 
     def __init__(self):
-        self._q: queue.Queue = queue.Queue(maxsize=1)
+        self._q: queue.Queue = queue.Queue()
         self._exc: Optional[BaseException] = None
+        self._pending_commit: Optional[tuple] = None   # (dir, meta)
         self._thread = threading.Thread(target=self._work, daemon=True)
         self._thread.start()
 
@@ -273,7 +307,9 @@ class AsyncSaver:
                 return
             try:
                 kind, path, payload, meta = job
-                if kind == "sharded":
+                if kind == "sharded_files":
+                    _write_shard_files(path + ".sharded", payload)
+                elif kind == "sharded":
                     _write_sharded(path, payload, meta)
                 else:
                     _write_npz(path, payload, meta)
@@ -287,21 +323,38 @@ class AsyncSaver:
             e, self._exc = self._exc, None
             raise RuntimeError("async checkpoint write failed") from e
 
+    def _drain(self):
+        """Join the in-flight write, surface its errors, then run any
+        deferred multi-host commit — main-thread only.  The pending commit
+        is dropped (not just postponed) when the write errored: committing
+        a step whose shard files failed would mark a broken checkpoint as
+        restorable."""
+        self._q.join()
+        pending, self._pending_commit = self._pending_commit, None
+        self._check()
+        if pending is not None:
+            _barrier_and_commit(*pending)
+
     def save(self, path: str, state: Any, *, step: Optional[int] = None,
              extra: Optional[dict] = None, sharded: bool = True) -> None:
-        self._check()
+        self._drain()
         if sharded:
             jobs, meta = snapshot_sharded(state)
             meta.update(step=step, extra=extra or {})
-            self._q.put(("sharded", path, jobs, meta))
+            if jax.process_count() > 1:
+                # defer the collective commit to the main thread (_drain)
+                self._q.put(("sharded_files", path, jobs, meta))
+                self._pending_commit = (path + ".sharded", meta)
+            else:
+                self._q.put(("sharded", path, jobs, meta))
         else:
             arrays, meta = _snapshot_npz(state, step, extra)
             self._q.put(("npz", path, arrays, meta))
 
     def wait(self) -> None:
-        """Block until all queued writes hit disk (call before exit)."""
-        self._q.join()
-        self._check()
+        """Block until all queued writes hit disk AND are committed (call
+        before exit)."""
+        self._drain()
 
     def close(self) -> None:
         self.wait()
@@ -310,8 +363,9 @@ class AsyncSaver:
 
 
 def latest_step(directory: str, prefix: str = "ckpt") -> Optional[int]:
-    """Highest step among ``<prefix>_<step>.npz`` files and committed
-    ``<prefix>_<step>.sharded/`` directories, or None."""
+    """Highest COMMITTED step: ``<prefix>_<step>.npz`` files whose ``.json``
+    sidecar (the npz commit marker) exists, and ``<prefix>_<step>.sharded/``
+    directories containing ``meta.json``.  Returns None if none."""
     if not os.path.isdir(directory):
         return None
     steps = []
@@ -319,6 +373,9 @@ def latest_step(directory: str, prefix: str = "ckpt") -> Optional[int]:
         if not name.startswith(prefix + "_"):
             continue
         if name.endswith(".npz"):
+            if not os.path.exists(
+                    os.path.join(directory, name[:-4] + ".json")):
+                continue   # bare .npz = interrupted, uncommitted write
             stem = name[len(prefix) + 1:-4]
         elif name.endswith(".sharded") and os.path.exists(
                 os.path.join(directory, name, "meta.json")):

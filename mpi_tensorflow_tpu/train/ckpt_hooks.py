@@ -99,7 +99,11 @@ class CheckpointHooks:
     # -- saving --
 
     def save_async(self, state: Any, t: int) -> None:
-        """Queue a checkpoint write; does not block the loop on disk."""
+        """Queue a checkpoint write.  The disk write happens off-thread,
+        but this call first waits for the PREVIOUS write to finish (the
+        saver's one-live-snapshot memory bound) — at trace cadence the
+        prior write has normally long completed, so the loop does not
+        stall in practice."""
         if self.saver is not None:
             self.saver.save(checkpoint.step_path(self.dir, t), state, step=t)
 

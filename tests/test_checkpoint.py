@@ -160,3 +160,70 @@ class TestLoopResume:
         # restored momentum/step counter: opt step equals total steps run
         assert float(r2.state.opt.step) == pytest.approx(
             r2.num_steps - (last + 1) + float(r1.state.opt.step))
+
+
+class TestCommitSemantics:
+    """ADVICE r2: commit markers and the async-commit threading contract."""
+
+    def test_bare_npz_is_not_committed(self, tmp_path):
+        """A kill between the .npz replace and the .json sidecar write must
+        fall back to the previous committed step, not crash restore."""
+        model = cnn.MnistCnn()
+        st = step.init_state(model, jax.random.key(1))
+        checkpoint.save(checkpoint.step_path(str(tmp_path), 3), st, step=3)
+        # simulate the interrupted write: npz present, sidecar missing
+        import shutil
+        p5 = checkpoint.step_path(str(tmp_path), 5)
+        shutil.copy(checkpoint.step_path(str(tmp_path), 3) + ".npz",
+                    p5 + ".npz")
+        assert checkpoint.latest_step(str(tmp_path)) == 3
+
+    def test_multihost_commit_runs_on_main_thread(self, tmp_path,
+                                                  monkeypatch):
+        """The sharded commit barrier is a device collective: with >1
+        process it must never run on the saver's worker thread (collective
+        enqueue order would race the train step's — pod deadlock).  The
+        worker writes shard files only; the barrier+meta commit happens in
+        the next main-thread save()/wait()."""
+        import threading
+
+        calls = []
+        real = checkpoint._barrier_and_commit
+
+        def spy(d, meta):
+            calls.append(threading.current_thread())
+            # skip the real barrier (single actual process) but do commit
+            import json as j, os as o
+            with open(o.path.join(d, "meta.json"), "w") as f:
+                j.dump(meta, f)
+
+        monkeypatch.setattr(checkpoint, "_barrier_and_commit", spy)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        try:
+            model = cnn.MnistCnn()
+            st = step.init_state(model, jax.random.key(1))
+            saver = checkpoint.AsyncSaver()
+            p = str(tmp_path / "ckpt_7")
+            saver.save(p, st, step=7, sharded=True)
+            # commit is deferred: no marker until a main-thread drain
+            assert not (tmp_path / "ckpt_7.sharded" / "meta.json").exists()
+            saver.wait()
+            assert (tmp_path / "ckpt_7.sharded" / "meta.json").exists()
+            assert calls == [threading.main_thread()]
+            saver.close()
+        finally:
+            monkeypatch.setattr(checkpoint, "_barrier_and_commit", real)
+
+    def test_async_saver_bounds_live_snapshots(self, tmp_path):
+        """A second save() joins the first write before snapshotting: at
+        most one host snapshot is live (the documented memory bound)."""
+        model = cnn.MnistCnn()
+        st = step.init_state(model, jax.random.key(1))
+        saver = checkpoint.AsyncSaver()
+        for s in (1, 2, 3):
+            saver.save(checkpoint.step_path(str(tmp_path), s), st, step=s)
+            # the previous write is fully on disk before this line returns
+            if s > 1:
+                assert checkpoint.latest_step(str(tmp_path)) >= s - 1
+        saver.close()
+        assert checkpoint.latest_step(str(tmp_path)) == 3
