@@ -228,6 +228,62 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     }
 
 
+def measure_decode(batch_size: int = 8, prompt_len: int = 32,
+                   new_tokens: int = 128, precision: str = "bf16",
+                   iters: int = 5) -> dict:
+    """Autoregressive decode throughput: tokens/sec through CausalLm's
+    KV-cache ``generate`` (greedy).  The per-token loop is a lax.scan over
+    a static cache, so the whole decode is one compiled dispatch."""
+    import dataclasses as dc
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_tensorflow_tpu.config import Config
+    from mpi_tensorflow_tpu.models import bert, gpt
+
+    cfg = Config(precision=precision)
+    bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype)
+    model = gpt.CausalLm(bcfg)
+    params = model.init(jax.random.key(0))
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(
+            0, bcfg.vocab_size, (batch_size, prompt_len)), jnp.int32)
+    def median_time(fn):
+        np.asarray(jax.tree.leaves(fn())[0])   # warmup + value-fetch sync
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            np.asarray(jax.tree.leaves(fn())[0])
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
+
+    # prefill is timed separately and subtracted: the decode metric must
+    # not scale with --prompt-len (a prefill-heavy call would otherwise
+    # report mostly prompt cost as per-token decode latency)
+    cache0 = model.init_cache(batch_size, prompt_len + new_tokens)
+    prefill = jax.jit(
+        lambda p, t: model.forward_with_cache(p, t, cache0, 0)[0])
+    gen = jax.jit(lambda p, t: model.generate(p, t, new_tokens))
+    prefill_sec = median_time(lambda: prefill(params, prompt))
+    gen_sec = median_time(lambda: gen(params, prompt))
+    decode_sec = max(gen_sec - prefill_sec, 1e-9)
+    return {
+        "model": "gpt_base",
+        "decode_tokens_per_sec": batch_size * new_tokens / decode_sec,
+        "per_token_ms": decode_sec / new_tokens * 1e3,
+        "prefill_ms": prefill_sec * 1e3,
+        "end_to_end_ms": gen_sec * 1e3,
+        "batch_size": batch_size,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "precision": precision,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def measure_allreduce(payload_mb: float = 25.4, iters: int = 50) -> dict:
     """Gradient-allreduce step time — the second half of the north-star
     metric ('allreduce step-time vs MPI baseline', BASELINE.json).
@@ -342,7 +398,12 @@ def main(argv=None) -> int:
                          "<10%% of the timed span) or 50 allreduce rounds")
     ap.add_argument("--batch-size", type=int, default=None,
                     help="per-chip batch; default per-model (MODEL_SPECS)")
-    ap.add_argument("--mode", choices=["train", "allreduce"], default="train")
+    ap.add_argument("--mode", choices=["train", "allreduce", "decode"],
+                    default="train")
+    ap.add_argument("--prompt-len", type=int, default=32,
+                    help="decode mode: prompt length")
+    ap.add_argument("--new-tokens", type=int, default=128,
+                    help="decode mode: generated tokens per call")
     ap.add_argument("--model", choices=list(MODEL_SPECS), default="mnist_cnn",
                     help="which BASELINE config to measure (train mode)")
     ap.add_argument("--scan-steps", type=int, default=None,
@@ -386,6 +447,21 @@ def main(argv=None) -> int:
                        "model": args.model, "mode": args.mode},
         }))
         return 1
+
+    if args.mode == "decode":
+        r = measure_decode(batch_size=args.batch_size or 8,
+                           prompt_len=args.prompt_len,
+                           new_tokens=args.new_tokens,
+                           precision=args.precision,
+                           iters=max(1, (args.steps or 5)))
+        print(json.dumps({
+            "metric": "GPT-base greedy decode throughput (KV cache)",
+            "value": round(r["decode_tokens_per_sec"], 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "detail": r,
+        }))
+        return 0
 
     if args.mode == "allreduce":
         r = measure_allreduce(payload_mb=args.payload_mb,

@@ -99,6 +99,40 @@ def _layernorm(x, p, eps=1e-12):
     return (x - mu) * jax.lax.rsqrt(var + eps) * p["scale"] + p["bias"]
 
 
+# -- shared per-layer math -------------------------------------------------
+# One definition serves the GSPMD encoder (BertMlm._encode_aux), the
+# pipelined stage (bert_pipeline._plain_layer), and the KV-cache decode
+# path (gpt.forward_with_cache): a change to the block cannot silently
+# diverge one of them.
+
+def qkv_proj(lp, h, dt):
+    """(B, S, E) -> per-head q, k, v, each (B, H, S, D)."""
+    q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
+        + lp["bq"].astype(dt)[None, :, None, :]
+    k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
+        + lp["bk"].astype(dt)[None, :, None, :]
+    v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt)) \
+        + lp["bv"].astype(dt)[None, :, None, :]
+    return q, k, v
+
+
+def attn_out_proj(lp, a, dt):
+    """Row-parallel attention output projection: (B, H, S, D) -> (B, S, E)."""
+    return jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
+        + lp["bo"].astype(dt)
+
+
+def gelu_mlp(lp, h, dt, constrain=None):
+    """Position-wise GELU MLP; ``constrain`` optionally annotates the
+    (B, S, mlp) intermediate with sharding."""
+    m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
+                    + lp["b1"].astype(dt))
+    if constrain is not None:
+        m = constrain(m)
+    return jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
+        + lp["b2"].astype(dt)
+
+
 @dataclasses.dataclass(frozen=True)
 class BertMlm:
     cfg: BertConfig = BERT_BASE
@@ -240,12 +274,9 @@ class BertMlm:
         """Position-wise MLP for layer ``idx`` -> (out, aux_loss).  The
         dense column/row-parallel MLP; MoE (models/moe.py) overrides this
         with routed experts on its MoE layers."""
-        dt = self.cfg.dtype
-        m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
-                        + lp["b1"].astype(dt))
-        m = self._constrain(m, ("batch", "seq", "mlp"))
-        m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
-            + lp["b2"].astype(dt)
+        m = gelu_mlp(lp, h, self.cfg.dtype,
+                     constrain=lambda m: self._constrain(
+                         m, ("batch", "seq", "mlp")))
         return m, jnp.zeros((), jnp.float32)
 
     def _aux_weight(self) -> float:
@@ -288,18 +319,12 @@ class BertMlm:
 
         def layer(h, lp, keys, mlp_fn):
             # --- attention (column-parallel QKV, row-parallel out) ---
-            q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
-                + lp["bq"].astype(dt)[None, :, None, :]
-            k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
-                + lp["bk"].astype(dt)[None, :, None, :]
-            v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt)) \
-                + lp["bv"].astype(dt)[None, :, None, :]
+            q, k, v = qkv_proj(lp, h, dt)
             q = self._constrain(q, ("batch", "heads", "seq", "head_dim"))
             k = self._constrain(k, ("batch", "heads", "seq", "head_dim"))
             v = self._constrain(v, ("batch", "heads", "seq", "head_dim"))
             a = self._attention(q, k, v)
-            a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
-                + lp["bo"].astype(dt)
+            a = attn_out_proj(lp, a, dt)
             h = _layernorm(h + drop_with(keys[0], a), lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))
             # --- MLP (dense column/row parallel, or routed experts) ---

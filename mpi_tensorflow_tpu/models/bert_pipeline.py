@@ -102,20 +102,11 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 return x
             return bert_lib.dropout_mask(x, self.cfg.dropout, drop(site))
 
-        q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
-            + lp["bq"].astype(dt)[None, :, None, :]
-        k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
-            + lp["bk"].astype(dt)[None, :, None, :]
-        v = jnp.einsum("bse,ehd->bhsd", h, lp["wv"].astype(dt)) \
-            + lp["bv"].astype(dt)[None, :, None, :]
+        q, k, v = bert_lib.qkv_proj(lp, h, dt)
         a = ring.dense_attention(q, k, v)
-        a = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
-            + lp["bo"].astype(dt)
+        a = bert_lib.attn_out_proj(lp, a, dt)
         h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)
-        m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
-                        + lp["b1"].astype(dt))
-        m = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
-            + lp["b2"].astype(dt)
+        m = bert_lib.gelu_mlp(lp, h, dt)
         return _layernorm(h + dropout(m, 1), lp["ln2"]).astype(dt)
 
     def _dropping(self, train: bool, rng) -> bool:

@@ -21,9 +21,12 @@ from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
+from jax import lax
 
 from mpi_tensorflow_tpu.models import bert as bert_lib
+from mpi_tensorflow_tpu.models.bert import _layernorm
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,3 +51,118 @@ class CausalLm(bert_lib.BertMlm):
 
     def _packs_positions(self) -> bool:
         return False   # every position carries loss — no mask packing
+
+    # ------------------------------------------------------------------
+    # autoregressive inference: KV cache + generate()
+    #
+    # The reference ships batched (non-autoregressive) inference only
+    # (mpipy.py:169-183); decoding extends that role to this family.
+    # TPU-shaped: the cache is a STATIC (B, H, max_len, D) buffer per
+    # layer updated with lax.dynamic_update_slice, the decode loop is a
+    # lax.scan — no data-dependent Python control flow, one compilation.
+    # ------------------------------------------------------------------
+
+    def init_cache(self, batch_size: int, max_len: int) -> list:
+        """Per-layer K/V buffers (zeros).  ``max_len`` caps prompt+output;
+        must be <= cfg.max_positions (position embeddings)."""
+        c = self.cfg
+        if max_len > c.max_positions:
+            raise ValueError(
+                f"max_len {max_len} exceeds max_positions {c.max_positions}")
+        z = jnp.zeros((batch_size, c.heads, max_len, c.head_dim), c.dtype)
+        return [{"k": z, "v": z} for _ in range(c.layers)]
+
+    def forward_with_cache(self, params, tokens, cache, offset):
+        """Forward ``tokens`` (B, S_in) occupying absolute positions
+        [offset, offset+S_in), reading/writing the KV cache.
+
+        One implementation serves both phases: prefill (S_in = prompt
+        length, offset 0) and single-token decode (S_in = 1, traced
+        offset).  Returns (fp32 logits (B, S_in, V), updated cache).
+
+        Decode runs unsharded (single-chip inference path); the sharded
+        batch case works through GSPMD on the batch dim of B.  Math is
+        kept in lockstep with the training stack — pinned by the
+        incremental-vs-full parity test (tests/test_gpt.py)."""
+        c = self.cfg
+        dt = c.dtype
+        B, S_in = tokens.shape
+        L = cache[0]["k"].shape[2]
+        offset = jnp.asarray(offset, jnp.int32)
+
+        pos_emb = lax.dynamic_slice(
+            params["pos_emb"], (offset, 0), (S_in, c.hidden))
+        h = params["tok_emb"][tokens] + pos_emb[None]
+        h = _layernorm(h, params["emb_ln"]).astype(dt)
+
+        pos = offset + jnp.arange(S_in)                    # (S_in,) absolute
+        col = jnp.arange(L)
+        # causal visibility over the cache: key position <= query position
+        vis = col[None, :] <= pos[:, None]                 # (S_in, L)
+        scale = c.head_dim ** -0.5
+
+        new_cache = []
+        for lp, cc in zip(params["layers"], cache):
+            q, k, v = bert_lib.qkv_proj(lp, h, dt)
+            ck = lax.dynamic_update_slice(cc["k"], k, (0, 0, offset, 0))
+            cv = lax.dynamic_update_slice(cc["v"], v, (0, 0, offset, 0))
+            new_cache.append({"k": ck, "v": cv})
+            s = jnp.einsum("bhsd,bhld->bhsl", q, ck).astype(jnp.float32)
+            s = jnp.where(vis[None, None], s * scale,
+                          jnp.finfo(jnp.float32).min)
+            p = jax.nn.softmax(s, axis=-1).astype(dt)
+            a = jnp.einsum("bhsl,bhld->bhsd", p, cv)
+            a = bert_lib.attn_out_proj(lp, a, dt)
+            h = _layernorm(h + a, lp["ln1"]).astype(dt)
+            m = bert_lib.gelu_mlp(lp, h, dt)
+            h = _layernorm(h + m, lp["ln2"]).astype(dt)
+
+        t = self.head_hidden(params, h)
+        logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
+            + params["mlm"]["out_b"]
+        return logits.astype(jnp.float32), new_cache
+
+    def generate(self, params, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, rng=None):
+        """Autoregressive decode: greedy (``temperature == 0``) or
+        temperature sampling.  ``prompt``: (B, S0) int ids.  Returns
+        (B, S0 + max_new_tokens) — the prompt with the continuation.
+
+        Prefill computes the whole prompt in one batched forward (MXU-
+        friendly); the per-token loop is a ``lax.scan`` over a static
+        cache, so the whole call is one ``jit`` compilation."""
+        if temperature > 0.0 and rng is None:
+            raise ValueError("temperature sampling needs an rng")
+        if max_new_tokens < 0:
+            raise ValueError(f"max_new_tokens must be >= 0, "
+                             f"got {max_new_tokens}")
+        if max_new_tokens == 0:
+            return prompt
+        B, S0 = prompt.shape
+        total = S0 + max_new_tokens
+        cache = self.init_cache(B, total)
+        logits, cache = self.forward_with_cache(params, prompt, cache, 0)
+        first = self._sample(logits[:, -1], temperature, rng, 0)
+
+        def step(carry, i):
+            cache, token, key = carry
+            logits, cache = self.forward_with_cache(
+                params, token[:, None], cache, S0 + i)
+            nxt = self._sample(logits[:, 0], temperature, key, i + 1)
+            return (cache, nxt, key), token
+
+        (_, last, _), toks = lax.scan(
+            step, (cache, first, rng if rng is not None
+                   else jax.random.key(0)),
+            jnp.arange(max_new_tokens - 1))
+        out = jnp.concatenate([toks.T, last[:, None]], axis=1) \
+            if max_new_tokens > 1 else first[:, None]
+        return jnp.concatenate([prompt, out], axis=1)
+
+    def _sample(self, logits, temperature, rng, i):
+        """(B, V) logits -> (B,) token ids."""
+        if temperature <= 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            jax.random.fold_in(rng, i),
+            logits / temperature, axis=-1).astype(jnp.int32)

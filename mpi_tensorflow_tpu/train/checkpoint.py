@@ -325,15 +325,27 @@ class AsyncSaver:
 
     def _drain(self):
         """Join the in-flight write, surface its errors, then run any
-        deferred multi-host commit — main-thread only.  The pending commit
-        is dropped (not just postponed) when the write errored: committing
-        a step whose shard files failed would mark a broken checkpoint as
-        restorable."""
+        deferred multi-host commit — main-thread only.
+
+        The commit decision must be AGREED across hosts before anyone
+        enters the commit barrier: if one host's shard write failed and it
+        raised while its peers proceeded to the barrier, the peers would
+        block in the collective forever (pod hang, no error surfaced).  So
+        every host first allgathers its ok-flag; all commit or none do,
+        and the healthy hosts raise a peer-failure error instead of
+        hanging.  A failed step is never committed (its marker is never
+        written), so resume falls back to the previous committed step."""
         self._q.join()
         pending, self._pending_commit = self._pending_commit, None
-        self._check()
         if pending is not None:
-            _barrier_and_commit(*pending)
+            local_ok = self._exc is None
+            if _all_hosts_ok(local_ok):
+                _barrier_and_commit(*pending)
+            elif local_ok:
+                raise RuntimeError(
+                    "sharded checkpoint write failed on a peer host; "
+                    "step not committed")
+        self._check()
 
     def save(self, path: str, state: Any, *, step: Optional[int] = None,
              extra: Optional[dict] = None, sharded: bool = True) -> None:
@@ -360,6 +372,18 @@ class AsyncSaver:
         self.wait()
         self._q.put(None)
         self._thread.join(timeout=10.0)
+
+
+def _all_hosts_ok(local_ok: bool) -> bool:
+    """Agree a boolean across hosts (allgather-AND); identity single-host.
+    Runs on the main thread at loop-aligned call sites only."""
+    if jax.process_count() == 1:
+        return local_ok
+    from jax.experimental import multihost_utils
+
+    flags = multihost_utils.process_allgather(
+        np.asarray([local_ok], dtype=np.bool_))
+    return bool(np.all(flags))
 
 
 def latest_step(directory: str, prefix: str = "ckpt") -> Optional[int]:

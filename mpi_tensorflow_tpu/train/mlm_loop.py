@@ -14,13 +14,13 @@ from typing import Any, Optional
 
 import jax
 import numpy as np
-import optax
 
 from mpi_tensorflow_tpu.config import Config
 from mpi_tensorflow_tpu.data import synthetic
 from mpi_tensorflow_tpu.models import bert
 from mpi_tensorflow_tpu.parallel import mesh as meshlib
 from mpi_tensorflow_tpu.train import gspmd
+from mpi_tensorflow_tpu.train import optimizer as opt_lib
 from mpi_tensorflow_tpu.utils import logging as logs
 from mpi_tensorflow_tpu.utils.timing import StepTimer
 
@@ -39,6 +39,7 @@ class MlmResult:
 def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
               mesh=None, seq_len: int = 128, train_n: int = 4096,
               test_n: int = 512, learning_rate: float = 1e-4,
+              lr_schedule: str = "warmup_linear",
               verbose: bool = True) -> MlmResult:
     mesh = mesh if mesh is not None else meshlib.make_mesh(config.mesh_shape)
     ndev = int(np.prod(list(mesh.shape.values())))
@@ -61,19 +62,6 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         model = bert_pipeline.PipelinedBertMlm(bert_cfg, mesh=mesh)
     else:
         model = bert.BertMlm(bert_cfg, mesh=mesh)
-    tx = optax.adamw(learning_rate)
-    state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
-                                   mesh)
-    train_step = gspmd.make_gspmd_train_step(
-        model, mesh, tx, grad_accum=getattr(config, "grad_accum", 1))
-    eval_step = gspmd.make_gspmd_eval_step(model, mesh)
-
-    from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
-
-    hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
-    start_step = 0
-    if config.resume:
-        state, start_step = hooks.resume(state)
 
     if getattr(config, "text_file", None):
         # real text via the byte-level tokenizer (data/corpus.py); the
@@ -107,6 +95,25 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         raise ValueError(
             f"train split ({train_n} sequences) is smaller than one global "
             f"batch ({b}); lower --batch-size or provide more data")
+
+    # warmup-linear adamw is the transformer default (VERDICT r2 #7: the
+    # reference's exponential decay, mpipy.py:60-64, serves the image
+    # families; adam needs warmup to survive its early-variance phase)
+    tx = opt_lib.transformer_tx(learning_rate, num_steps,
+                                schedule=lr_schedule)
+    state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
+                                   mesh)
+    train_step = gspmd.make_gspmd_train_step(
+        model, mesh, tx, grad_accum=getattr(config, "grad_accum", 1))
+    eval_step = gspmd.make_gspmd_eval_step(model, mesh)
+
+    from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
+
+    hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
+    start_step = 0
+    if config.resume:
+        state, start_step = hooks.resume(state)
+
     rng = jax.random.key(config.seed + 2)
     timer = StepTimer(warmup_steps=1)
     history = []

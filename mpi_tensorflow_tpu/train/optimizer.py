@@ -77,3 +77,59 @@ def make_optax(config, local_train_size: int) -> optax.GradientTransformation:
 def adamw(learning_rate=1e-4, weight_decay=0.01, **kw):
     """Convenience passthrough for transformer runs (BASELINE config 5)."""
     return optax.adamw(learning_rate, weight_decay=weight_decay, **kw)
+
+
+# ---------------------------------------------------------------------------
+# transformer-family schedules (no counterpart in the reference, whose only
+# schedule is the exponential decay above — mpipy.py:60-64; BERT/GPT
+# training needs warmup to survive adam's early variance)
+# ---------------------------------------------------------------------------
+
+def warmup_linear(base_lr: float, warmup_steps: int, total_steps: int,
+                  end_fraction: float = 0.0):
+    """BERT's schedule: LR ramps 0 -> ``base_lr`` linearly over
+    ``warmup_steps``, then decays linearly to ``end_fraction * base_lr`` at
+    ``total_steps`` (flat afterwards).  Pure and jit-safe; ``step`` may be
+    a traced scalar."""
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(float(warmup_steps), 1.0)
+        frac = (step - warmup_steps) \
+            / jnp.maximum(float(total_steps - warmup_steps), 1.0)
+        decay = 1.0 - (1.0 - end_fraction) * jnp.clip(frac, 0.0, 1.0)
+        return base_lr * jnp.where(step < warmup_steps, warm, decay)
+    return schedule
+
+
+def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
+                  end_fraction: float = 0.0):
+    """Linear warmup then cosine decay to ``end_fraction * base_lr`` (the
+    GPT-family default)."""
+    def schedule(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = step / jnp.maximum(float(warmup_steps), 1.0)
+        frac = jnp.clip((step - warmup_steps)
+                        / jnp.maximum(float(total_steps - warmup_steps), 1.0),
+                        0.0, 1.0)
+        decay = end_fraction + (1.0 - end_fraction) \
+            * 0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+        return base_lr * jnp.where(step < warmup_steps, warm, decay)
+    return schedule
+
+
+def transformer_tx(base_lr: float, num_steps: int, *,
+                   schedule: str = "warmup_linear",
+                   warmup_fraction: float = 0.1,
+                   weight_decay: float = 0.01) -> optax.GradientTransformation:
+    """adamw under the named schedule — the default for the BERT/GPT loops
+    (constant LR remains available as ``schedule="constant"``)."""
+    warmup = max(1, int(warmup_fraction * num_steps))
+    if schedule == "constant":
+        lr = base_lr
+    elif schedule == "warmup_linear":
+        lr = warmup_linear(base_lr, warmup, num_steps)
+    elif schedule == "warmup_cosine":
+        lr = warmup_cosine(base_lr, warmup, num_steps)
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    return optax.adamw(lr, weight_decay=weight_decay)

@@ -34,6 +34,7 @@ run python bench.py --model resnet50 --precision bf16 --batch-size 256 --remat
 # 3. new families
 run python bench.py --model moe_bert --precision bf16
 run python bench.py --model gpt_base --precision bf16
+run python bench.py --mode decode --precision bf16
 
 # 4. unchanged configs (re-record under today's tenancy)
 run python bench.py

@@ -94,3 +94,14 @@ class TestMeasureBertDetail:
         assert r["paths"]["ce_positions"] == "masked_packed"
         assert "ce" in r["paths"]
         assert r["flash_probe"] == {"float32/causal=False": False}
+
+
+class TestMeasureDecode:
+    def test_decode_detail(self, monkeypatch):
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_decode(batch_size=2, prompt_len=8, new_tokens=4,
+                                 precision="fp32", iters=1)
+        assert r["decode_tokens_per_sec"] > 0
+        assert r["new_tokens"] == 4 and r["batch_size"] == 2

@@ -199,6 +199,7 @@ class TestCommitSemantics:
 
         monkeypatch.setattr(checkpoint, "_barrier_and_commit", spy)
         monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(checkpoint, "_all_hosts_ok", lambda ok: ok)
         try:
             model = cnn.MnistCnn()
             st = step.init_state(model, jax.random.key(1))
@@ -227,3 +228,36 @@ class TestCommitSemantics:
                 assert checkpoint.latest_step(str(tmp_path)) >= s - 1
         saver.close()
         assert checkpoint.latest_step(str(tmp_path)) == 3
+
+    def test_peer_write_failure_skips_commit_and_raises(self, tmp_path,
+                                                        monkeypatch):
+        """If any host's shard write failed, NO host may enter the commit
+        barrier (the healthy ones raise instead of hanging in a collective
+        their failed peer never joins)."""
+        model = cnn.MnistCnn()
+        st = step.init_state(model, jax.random.key(1))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(checkpoint, "_all_hosts_ok", lambda ok: False)
+        saver = checkpoint.AsyncSaver()
+        p = str(tmp_path / "ckpt_9")
+        saver.save(p, st, step=9, sharded=True)   # local write succeeds
+        with pytest.raises(RuntimeError, match="peer host"):
+            saver.wait()
+        assert not (tmp_path / "ckpt_9.sharded" / "meta.json").exists()
+
+    def test_local_write_failure_never_commits(self, tmp_path, monkeypatch):
+        model = cnn.MnistCnn()
+        st = step.init_state(model, jax.random.key(1))
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+
+        def boom(d, jobs):
+            raise OSError("disk full")
+
+        monkeypatch.setattr(checkpoint, "_write_shard_files", boom)
+        monkeypatch.setattr(checkpoint, "_all_hosts_ok", lambda ok: ok)
+        saver = checkpoint.AsyncSaver()
+        p = str(tmp_path / "ckpt_11")
+        saver.save(p, st, step=11, sharded=True)
+        with pytest.raises(RuntimeError, match="checkpoint write failed"):
+            saver.wait()
+        assert not (tmp_path / "ckpt_11.sharded" / "meta.json").exists()

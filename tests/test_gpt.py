@@ -97,3 +97,68 @@ class TestTraining:
         batch = gspmd.shard_batch({"tokens": toks}, mesh)
         l2, _ = sharded.loss(p2, None, batch)
         np.testing.assert_allclose(float(l2), float(l1), rtol=2e-5)
+
+class TestDecode:
+    """KV-cache autoregressive inference (VERDICT r2 #6): incremental
+    logits must equal the full forward's at every step."""
+
+    def _setup(self, b=2, s=24):
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        return model, params, _tokens(b=b, s=s, seed=3)
+
+    def test_prefill_matches_full_forward(self):
+        model, params, toks = self._setup()
+        full = np.asarray(model.apply(params, toks))
+        cache = model.init_cache(toks.shape[0], toks.shape[1])
+        inc, _ = model.forward_with_cache(params, toks, cache, 0)
+        np.testing.assert_allclose(np.asarray(inc), full, rtol=2e-4,
+                                   atol=2e-4)
+
+    def test_incremental_matches_full_at_every_step(self):
+        model, params, toks = self._setup(s=16)
+        B, S = toks.shape
+        full = np.asarray(model.apply(params, toks))
+        cache = model.init_cache(B, S)
+        step = jax.jit(model.forward_with_cache)
+        for t in range(S):
+            logits, cache = step(params, toks[:, t:t + 1], cache, t)
+            np.testing.assert_allclose(
+                np.asarray(logits[:, 0]), full[:, t], rtol=2e-4, atol=2e-4,
+                err_msg=f"divergence at decode step {t}")
+
+    def test_greedy_generate_continues_prompt(self):
+        model, params, toks = self._setup(b=2, s=8)
+        gen = jax.jit(lambda p, t: model.generate(p, t, 6))(params, toks)
+        assert gen.shape == (2, 14)
+        np.testing.assert_array_equal(np.asarray(gen[:, :8]),
+                                      np.asarray(toks))
+        # greedy continuation must equal argmax of the full forward, token
+        # by token (teacher-forcing on its own output)
+        cur = np.asarray(toks)
+        for t in range(6):
+            logits = np.asarray(model.apply(params, jnp.asarray(cur)))
+            nxt = logits[:, -1].argmax(-1)
+            np.testing.assert_array_equal(np.asarray(gen[:, 8 + t]), nxt,
+                                          err_msg=f"token {t}")
+            cur = np.concatenate([cur, nxt[:, None].astype(np.int32)], 1)
+
+    def test_single_new_token(self):
+        model, params, toks = self._setup(b=1, s=8)
+        gen = model.generate(params, toks, 1)
+        assert gen.shape == (1, 9)
+
+    def test_temperature_sampling_needs_rng_and_varies(self):
+        model, params, toks = self._setup(b=4, s=8)
+        with pytest.raises(ValueError, match="rng"):
+            model.generate(params, toks, 4, temperature=0.8)
+        g1 = model.generate(params, toks, 8, temperature=5.0,
+                            rng=jax.random.key(1))
+        g2 = model.generate(params, toks, 8, temperature=5.0,
+                            rng=jax.random.key(2))
+        assert not np.array_equal(np.asarray(g1), np.asarray(g2))
+
+    def test_cache_caps_at_max_positions(self):
+        model, params, _ = self._setup()
+        with pytest.raises(ValueError, match="max_positions"):
+            model.init_cache(1, TINY.max_positions + 1)

@@ -141,3 +141,32 @@ class TestOptimizer:
         got = float(step.optax_softmax_ce(logits, labels)[0])
         want = -np.log(np.exp(2) / (np.exp(2) + np.exp(1) + np.exp(0)))
         assert got == pytest.approx(want, rel=1e-4)
+
+    def test_warmup_linear_golden(self):
+        """warmup 100 of 1000 total, base 1e-3: ramp, peak, midpoint-decay,
+        floor."""
+        f = optimizer.warmup_linear(1e-3, 100, 1000)
+        assert float(f(0)) == pytest.approx(0.0)
+        assert float(f(50)) == pytest.approx(5e-4)
+        assert float(f(100)) == pytest.approx(1e-3)
+        # halfway through decay: 1 - 450/900 = 0.5
+        assert float(f(550)) == pytest.approx(5e-4)
+        assert float(f(1000)) == pytest.approx(0.0)
+        assert float(f(1500)) == pytest.approx(0.0)   # flat past the end
+
+    def test_warmup_cosine_golden(self):
+        f = optimizer.warmup_cosine(2e-3, 100, 1100, end_fraction=0.1)
+        assert float(f(0)) == pytest.approx(0.0)
+        assert float(f(100)) == pytest.approx(2e-3)
+        # cosine midpoint: end + (1-end)*0.5 = 0.55 of base
+        assert float(f(600)) == pytest.approx(2e-3 * 0.55, rel=1e-5)
+        assert float(f(1100)) == pytest.approx(2e-4, rel=1e-5)
+
+    def test_transformer_tx_schedules(self):
+        import optax
+
+        for name in ("constant", "warmup_linear", "warmup_cosine"):
+            tx = optimizer.transformer_tx(1e-3, 100, schedule=name)
+            assert isinstance(tx, optax.GradientTransformation)
+        with pytest.raises(ValueError, match="unknown schedule"):
+            optimizer.transformer_tx(1e-3, 100, schedule="nope")
