@@ -16,12 +16,18 @@ Call ``ring_attention`` inside ``shard_map`` with the ``seq`` axis in scope;
 when the mesh has no seq axis).
 
 Kernel note: the per-hop online-softmax update stays in XLA rather than the
-Pallas flash kernel (ops/flash_attention.py).  Each hop's score block is
-(S_local, S_local) and lives entirely in registers/VMEM under XLA fusion;
-using the Pallas kernel per hop would require carrying its (o, m, l)
-accumulators through HBM between hops AND a chunk-level custom VJP for the
-scan's backward — cost without benefit at the S_local (<= a few K) a ring
-shard holds.  The Ulysses path is where the kernel pays off (each shard
+Pallas flash kernel (ops/flash_attention.py).  Using the Pallas kernel per
+hop would require carrying its (o, m, l) accumulators through HBM between
+hops AND a chunk-level custom VJP for the scan's backward.  Instead the hop
+itself goes BLOCKWISE above a threshold: for S_local > ``_CHUNK_ABOVE`` the
+hop streams the K/V block in ``block_k``-wide chunks through the same
+online-softmax update (a nested ``lax.scan``), so per-hop score memory is
+O(S_local * block_k) instead of O(S_local^2) — the regime S_local >= 4k
+needs.  Each chunk update is ``jax.checkpoint``ed: the backward recomputes
+chunk scores rather than storing every chunk's probabilities, keeping the
+training-step footprint bounded as well.  Below the threshold the single-
+block hop is kept (fewer scans, and the (S_local, S_local) block fuses
+fine).  The Ulysses path is where the Pallas kernel pays off (each shard
 sees the full sequence) and does use it (models/bert.py `_attention`).
 """
 
@@ -50,19 +56,62 @@ def dense_attention(q, k, v, *, causal: bool = False,
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(v.dtype), v)
 
 
+# chunk the hop's K/V block when the local sequence exceeds this (the
+# (S_local, S_local) fp32 score block at 1024 is 4 MB per (B, H) — beyond
+# it, blockwise wins; below it, fusion of the single block is cheaper)
+_CHUNK_ABOVE = 1024
+_DEFAULT_BLOCK_K = 512
+
+
+def _online_update(q, kb, vb, o, m, l, qpos, kpos, scale, causal):
+    """One online-softmax accumulator update against K/V block ``kb/vb``.
+    ``qpos``/``kpos``: absolute positions for the causal mask (ignored when
+    ``causal`` is False).  Shared by the single-block and chunked hops."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        s = jnp.where(kpos[None, :] > qpos[:, None], NEG_INF, s)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    # all-masked-so-far rows keep m == -inf; normalize against 0 there so
+    # exp() never sees (-inf) - (-inf)
+    m_use = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+    p = jnp.exp(s - m_use[..., None])
+    corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_use))
+    l = l * corr + jnp.sum(p, axis=-1)
+    o = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
+        preferred_element_type=jnp.float32)
+    return o, m_new, l
+
+
 def ring_attention(q, k, v, axis_name: str = "seq", *,
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   block_k: Optional[int] = None):
     """Blockwise ring attention.  q,k,v: (B, H, S_local, D) per shard.
 
     Equivalent to ``dense_attention`` on the gathered sequence (validated in
-    tests/test_ring.py); per-shard memory is O(S_local^2) scores instead of
-    O(S^2), and communication is n-1 neighbor ``ppermute`` hops overlapping
-    compute.
+    tests/test_ring.py); communication is n-1 neighbor ``ppermute`` hops
+    overlapping compute.  Per-shard score memory is O(S_local^2) for short
+    shards and O(S_local * block_k) once the hop goes blockwise
+    (S_local > 1024, or ``block_k`` set explicitly — see module docstring).
     """
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     scale = scale if scale is not None else q.shape[-1] ** -0.5
     bq = q.shape[2]
+    s_local = k.shape[2]
+    if block_k is None and s_local > _CHUNK_ABOVE:
+        # auto: the largest divisor of S_local <= the default block (gcd);
+        # degenerate shard lengths (gcd < 128: tiny chunks would serialize
+        # the MXU) keep the single-block hop rather than erroring — a
+        # caller that passed no block_k must never see a divisibility error
+        import math
+
+        cand = math.gcd(s_local, _DEFAULT_BLOCK_K)
+        block_k = cand if cand >= 128 else None
+    if block_k is not None and (block_k <= 0 or s_local % block_k):
+        raise ValueError(
+            f"block_k {block_k} must divide the local K length {s_local}")
     # the accumulators must carry the same varying-axes type as q/k/v (they
     # are per-shard values), or the scan carry type check fails; deriving
     # them from q (rather than lax.pvary) inherits whatever set of mesh axes
@@ -76,26 +125,34 @@ def ring_attention(q, k, v, axis_name: str = "seq", *,
     def body(carry, i):
         o, m, l, kb, vb = carry
         blk = (my - i) % n                                # global idx of kb
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, kb,
-                       preferred_element_type=jnp.float32) * scale
-        if causal:
-            qpos = my * bq + jnp.arange(bq)[:, None]
-            kpos = blk * kb.shape[2] + jnp.arange(kb.shape[2])[None, :]
-            s = jnp.where(kpos > qpos, NEG_INF, s)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        # all-masked-so-far rows keep m == -inf; normalize against 0 there so
-        # exp() never sees (-inf) - (-inf)
-        m_use = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
-        p = jnp.exp(s - m_use[..., None])
-        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - m_use))
-        l = l * corr + jnp.sum(p, axis=-1)
-        o = o * corr[..., None] + jnp.einsum(
-            "bhqk,bhkd->bhqd", p.astype(vb.dtype), vb,
-            preferred_element_type=jnp.float32)
+        qpos = my * bq + jnp.arange(bq)
+        if block_k is None:
+            kpos = blk * s_local + jnp.arange(s_local)
+            o, m, l = _online_update(q, kb, vb, o, m, l, qpos, kpos,
+                                     scale, causal)
+        else:
+            nc = s_local // block_k
+            kcs = jnp.moveaxis(
+                kb.reshape(kb.shape[:2] + (nc, block_k, kb.shape[3])), 2, 0)
+            vcs = jnp.moveaxis(
+                vb.reshape(vb.shape[:2] + (nc, block_k, vb.shape[3])), 2, 0)
+
+            def chunk(acc, xs):
+                o, m, l = acc
+                kc, vc, ci = xs
+                kpos = blk * s_local + ci * block_k + jnp.arange(block_k)
+                return _online_update(q, kc, vc, o, m, l, qpos, kpos,
+                                      scale, causal), None
+
+            # remat: the backward recomputes each chunk's scores instead of
+            # storing every chunk's (B, H, Sq, block_k) probabilities —
+            # this is what keeps the TRAINING footprint at O(Sq * block_k)
+            (o, m, l), _ = lax.scan(jax.checkpoint(chunk), (o, m, l),
+                                    (kcs, vcs, jnp.arange(nc)))
         perm = [(j, (j + 1) % n) for j in range(n)]
         kb = lax.ppermute(kb, axis_name, perm)
         vb = lax.ppermute(vb, axis_name, perm)
-        return (o, m_new, l, kb, vb), None
+        return (o, m, l, kb, vb), None
 
     (o, m, l, _, _), _ = lax.scan(body, (o, m, l, k, v), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)
