@@ -86,6 +86,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.prefetch,
                    help="background window assembly for the fused loop "
                         "(native = C++ worker, data/prefetch.py)")
+    p.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+                   default=d.pp_schedule,
+                   help="pipeline schedule for --mesh pipe=N runs: gpipe "
+                        "(autodiff backward) or 1f1b (interleaved "
+                        "one-forward-one-backward; same bubble, O(P) "
+                        "activation stash)")
     p.add_argument("--grad-accum", type=int, default=d.grad_accum,
                    help="microbatches accumulated per optimizer step "
                         "(activation-memory / batch-size trade)")
@@ -117,6 +123,7 @@ def config_from_args(args) -> Config:
         mesh_shape=parse_mesh(args.mesh), text_file=args.text_file,
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         precision=args.precision, grad_accum=args.grad_accum,
+        pp_schedule=args.pp_schedule,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),

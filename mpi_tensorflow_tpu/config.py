@@ -68,6 +68,11 @@ class Config:
                                   # loop: "auto" (native C++ worker when
                                   # built, else Python thread), "native",
                                   # "thread", "off" (inline assembly)
+    pp_schedule: str = "gpipe"    # pipeline-parallel training schedule:
+                                  # "gpipe" (scanned fwd pipeline, autodiff
+                                  # backward) or "1f1b" (interleaved
+                                  # one-forward-one-backward — same bubble,
+                                  # O(P) stashed activations)
     grad_accum: int = 1           # microbatches per step: grads accumulate
                                   # on-device (lax.scan) before the single
                                   # allreduce+update — same semantics, 1/A

@@ -84,6 +84,15 @@ def _norm_init(key, shape, stddev=0.02):
     return jax.random.normal(key, shape) * stddev
 
 
+def ce_capacity(cfg, S: int) -> int:
+    """Packed-buffer width for the masked-position head: per-row capacity
+    ``ce_capacity_frac * S`` rounded up to a multiple of 8 (lane-friendly),
+    floored at 8, capped at S.  The ONE definition shared by BertMlm.loss
+    and the pipelined 1F1B microbatch loss — the schedules' loss parity
+    depends on both computing the identical cap."""
+    return min(S, max(8, -(-int(cfg.ce_capacity_frac * S) // 8) * 8))
+
+
 def dropout_mask(x, rate: float, key):
     """Inverted dropout: zero with prob ``rate``, scale survivors by
     1/keep.  The single implementation shared by BertMlm's keyed streams
@@ -421,11 +430,9 @@ class BertMlm:
             from mpi_tensorflow_tpu.ops import mlm_head
 
             engagement.record("ce_positions", "masked_packed")
-            S = h.shape[1]
-            cap = min(S, max(8, -(-int(self.cfg.ce_capacity_frac * S) // 8)
-                             * 8))
             packed, plabels, w = mlm_head.gather_masked_rows(
-                h, labels, mask.astype(jnp.bool_), cap)
+                h, labels, mask.astype(jnp.bool_),
+                ce_capacity(self.cfg, h.shape[1]))
             t = self.head_hidden(params, packed)
             ce = self._ce(params, t, plabels)
             weights = w

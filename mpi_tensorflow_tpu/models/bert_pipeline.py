@@ -21,17 +21,21 @@ schedule hands each stage the index of the microbatch it is processing
 independent masks — including under remat, which replays the same fold
 inputs and hence identical masks in the recomputation.
 
-Memory schedule: GPipe stores ~M microbatch boundary activations for the
-backward pipeline.  The 1F1B peak of O(P) in-flight activations is obtained
-compositionally: set ``num_microbatches = P`` and use the train step's
-``grad_accum`` to scan over microbatch *groups* — each group pipelines P
-microbatches (peak O(P) activations, exactly 1F1B's), and groups accumulate
-gradients sequentially (pinned by
-tests/test_moe_pipeline.py::test_pipeline_with_grad_accum).  The price vs a
-hand-interleaved 1F1B is bubble fraction ((P-1)/(2P-1) per group instead of
-(P-1)/(M+P-1) overall); ``cfg.remat`` additionally recomputes within-stage
-activations in the backward.  TP/SP inside a stage and a hand-interleaved
-1F1B schedule remain future work.
+Memory schedules, from cheapest to most capable:
+- GPipe (``schedule="gpipe"``, default): the scanned forward pipeline with
+  autodiff backward — stores ~M microbatch boundary activations; bubble
+  (P-1)/(M+P-1) each way.
+- Microbatch groups: ``num_microbatches = P`` + the train step's
+  ``grad_accum`` — O(P) activations at bubble (P-1)/(2P-1) per group
+  (pinned by tests/test_moe_pipeline.py::test_pipeline_with_grad_accum).
+- Interleaved 1F1B (``schedule="1f1b"``): hand-interleaved
+  one-forward-one-backward via ``parallel/pipeline.pipeline_1f1b`` — the
+  same (P-1)/(M+P-1) bubble as end-to-end GPipe but only O(P) stashed
+  activations (each stage's backward recomputes its forward from the
+  stashed input).  Loss/grad parity with GPipe is pinned by
+  tests/test_moe_pipeline.py::TestOneFOneB.
+``cfg.remat`` additionally recomputes within-stage activations in the
+backward.  TP/SP inside a stage remains future work.
 
 No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
 """
@@ -39,6 +43,7 @@ No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -49,6 +54,38 @@ from mpi_tensorflow_tpu.models import bert as bert_lib
 from mpi_tensorflow_tpu.models.bert import _layernorm
 from mpi_tensorflow_tpu.parallel import pipeline as pipeline_lib
 from mpi_tensorflow_tpu.parallel import ring
+
+
+def _float0(x):
+    """Zero cotangent for a non-differentiable input (ints, prng keys)."""
+    import numpy as np
+
+    return np.zeros(jnp.shape(x), jax.dtypes.float0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _sched_loss(run, sp, hp, h, labels, mask, inv, key):
+    """Splice the 1F1B schedule's manually accumulated gradients into the
+    outer autodiff: the schedule computes loss AND grads in one interleaved
+    pass (that is its point), so the VJP just scales the saved grads by the
+    upstream cotangent.  ``run`` is the shard_mapped schedule (static)."""
+    return run(sp, hp, h, labels, mask, inv, key)[0]
+
+
+def _sched_fwd(run, sp, hp, h, labels, mask, inv, key):
+    loss, gs, gl, dmb = run(sp, hp, h, labels, mask, inv, key)
+    return loss, (gs, gl, dmb.astype(h.dtype), labels, mask, inv, key)
+
+
+def _sched_bwd(run, res, ct):
+    gs, gl, dmb, labels, mask, inv, key = res
+    scale = lambda tree: jax.tree.map(lambda x: x * ct, tree)  # noqa: E731
+    return (scale(gs), scale(gl), (dmb * ct).astype(dmb.dtype),
+            _float0(labels), _float0(mask),
+            jnp.zeros_like(inv), _float0(key))
+
+
+_sched_loss.defvjp(_sched_fwd, _sched_bwd)
 
 
 def stack_layers(layers: list, num_stages: int):
@@ -65,8 +102,17 @@ def stack_layers(layers: list, num_stages: int):
 
 @dataclasses.dataclass(frozen=True)
 class PipelinedBertMlm(bert_lib.BertMlm):
-    """BERT-MLM with the encoder pipelined over the mesh's ``pipe`` axis."""
+    """BERT-MLM with the encoder pipelined over the mesh's ``pipe`` axis.
+
+    ``schedule``: "gpipe" (the scanned forward pipeline; backward derived
+    by autodiff — stores M microbatch boundary activations) or "1f1b"
+    (interleaved one-forward-one-backward, parallel/pipeline.py
+    ``pipeline_1f1b`` — same (P-1)/(M+P-1) bubble, but only O(P) stashed
+    activations, the pod-scale memory schedule).  "1f1b" applies to the
+    training loss; forward-only encode/apply always use the GPipe scan
+    (there is no backward to interleave with)."""
     num_microbatches: int = 4
+    schedule: str = "gpipe"
 
     @property
     def _num_stages(self) -> int:
@@ -144,11 +190,11 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         h, _ = lax.scan(body, x, (stage_params, jnp.arange(Lp)))
         return h
 
-    def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
+    def _embed(self, params, tokens, dropping: bool, rng):
+        """Token+position embeddings (+LN, + the first dropout site) — the
+        replicated front section shared by both pipeline schedules."""
         c = self.cfg
-        dropping = self._dropping(train, rng)
-        dt = c.dtype
-        B, S = tokens.shape
+        S = tokens.shape[1]
         h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
         h = _layernorm(h, params["emb_ln"])
         if dropping:
@@ -156,8 +202,13 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             # no in-stage fold chain can collide with
             h = bert_lib.dropout_mask(h, c.dropout,
                                       jax.random.fold_in(rng, 2 ** 30))
-        h = h.astype(dt)
-        h = self._constrain(h, ("batch", "seq", "embed"))
+        h = h.astype(c.dtype)
+        return self._constrain(h, ("batch", "seq", "embed"))
+
+    def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
+        dropping = self._dropping(train, rng)
+        B, S = tokens.shape
+        h = self._embed(params, tokens, dropping, rng)
 
         n_stages = self._num_stages
         if n_stages == 1:   # no pipe axis: plain sequential stack
@@ -200,3 +251,101 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             check_vma=False)(params["layers"], h, key)
         h = self._constrain(h, ("batch", "seq", "embed"))
         return h, jnp.zeros((), jnp.float32)
+
+    # ------------------------------------------------------------------
+    # interleaved 1F1B training path
+    # ------------------------------------------------------------------
+
+    def _mb_loss(self, head_params, y, labels_i, mask_i, inv):
+        """Microbatch loss contribution (already globally normalized by
+        ``inv`` = 1/total masked count, so contributions SUM to the same
+        loss the GPipe path computes).  Runs on the last stage only."""
+        c = self.cfg
+        if c.ce_positions == "masked":
+            from mpi_tensorflow_tpu.ops import mlm_head
+
+            bert_lib.engagement.record("ce_positions", "masked_packed")
+            packed, plab, w = mlm_head.gather_masked_rows(
+                y, labels_i, mask_i.astype(jnp.bool_),
+                bert_lib.ce_capacity(c, y.shape[1]))
+            t = self.head_hidden(head_params, packed)
+            ce = self._ce(head_params, t, plab)
+            weights = w
+        else:
+            bert_lib.engagement.record("ce_positions", "all")
+            t = self.head_hidden(head_params, y)
+            ce = self._ce(head_params, t, labels_i)
+            weights = mask_i.astype(jnp.float32)
+        return jnp.sum(ce * weights) * inv
+
+    def loss(self, params, model_state, batch, labels, *, rng=None,
+             train: bool = False):
+        if self.schedule != "1f1b" or self._num_stages == 1 or not train:
+            bert_lib.engagement.record("pp_schedule", "gpipe")
+            return super().loss(params, model_state, batch, labels,
+                                rng=rng, train=train)
+        bert_lib.engagement.record("pp_schedule", "1f1b")
+
+        c = self.cfg
+        tokens, mask = batch["tokens"], batch["mask"]
+        B, S = tokens.shape
+        dropping = self._dropping(train, rng)
+        M = self.num_microbatches
+        dp = self.mesh.shape.get("data", 1)
+        if (B // dp) % M:
+            raise ValueError(
+                f"per-data-shard batch {B // dp} not divisible by "
+                f"{M} microbatches")
+        h = self._embed(params, tokens, dropping, rng)
+        # global normalizer, fixed before the schedule (data-only, no
+        # grad): per-microbatch SUMS scaled by it add up to exactly the
+        # GPipe path's globally normalized mean
+        inv = 1.0 / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
+        head_params = {"mlm": params["mlm"], "tok_emb": params["tok_emb"]}
+        key = rng if dropping else jax.random.key(0)
+        h_spec = P("data" if dp > 1 else None)
+        # the in-schedule head/CE math runs INSIDE shard_map, where GSPMD
+        # sharding constraints are illegal — a mesh-free view of this model
+        # computes the same math without annotations
+        plain = dataclasses.replace(self, mesh=None)
+
+        def inner(stacked_local, hp, hl, labels_l, mask_l, inv, key):
+            sp = jax.tree.map(lambda x: x[0], stacked_local)
+            mbsz = hl.shape[0] // M
+            mb = hl.reshape((M, mbsz) + hl.shape[1:])
+            lab = labels_l.reshape((M, mbsz) + labels_l.shape[1:])
+            msk = mask_l.reshape((M, mbsz) + mask_l.shape[1:])
+            if dropping:
+                key = jax.random.fold_in(
+                    key, lax.axis_index("data") if dp > 1 else 0)
+            sidx = lax.axis_index("pipe")
+
+            def stage_fn(p, x, mi):
+                return self._stage(p, x, rng=key if dropping else None,
+                                   mb_idx=mi, stage_idx=sidx)
+
+            def last_fn(hp, y, aux):
+                labels_i, mask_i = aux
+                return plain._mb_loss(hp, y, labels_i, mask_i, inv)
+
+            loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b(
+                stage_fn, last_fn, sp, hp, mb, (lab, msk), "pipe")
+            # sum loss/replicated-param grads over the data shards too
+            # (each shard saw a different batch slice of the global mean)
+            if dp > 1:
+                loss = lax.psum(loss, "data")
+                gl = jax.tree.map(lambda x: lax.psum(x, "data"), gl)
+                gs = jax.tree.map(lambda x: lax.psum(x, "data"), gs)
+            # restore the stacked leading stage axis for the out_spec
+            gs = jax.tree.map(lambda x: x[None], gs)
+            return loss, gs, gl, dmb.reshape(hl.shape)
+
+        run = jax.shard_map(
+            inner, mesh=self.mesh,
+            in_specs=(P("pipe"), P(), h_spec, h_spec, h_spec, P(), P()),
+            out_specs=(P(), P("pipe"), P(), h_spec),
+            check_vma=False)
+
+        loss = _sched_loss(run, params["layers"], head_params, h, labels,
+                           mask, inv, key)
+        return loss, model_state

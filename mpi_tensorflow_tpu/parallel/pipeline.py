@@ -86,6 +86,175 @@ def pipeline(stage_fn: Callable, stage_params: Any, microbatches,
     return outputs
 
 
+# ---------------------------------------------------------------------------
+# interleaved 1F1B
+# ---------------------------------------------------------------------------
+#
+# Slot algebra (P stages, M microbatches, one op per stage per tick):
+#
+#   forward  of microbatch i at stage s:  tick  s + 2i
+#   backward of microbatch i at stage s:  tick  2P-1-s + 2i
+#
+# Checks: F and B land on disjoint tick parities per stage (never collide);
+# a message produced at tick t is consumed by the neighbor at t+1 (one
+# ppermute per tick each way); the last tick is 2M+2P-3, so the schedule is
+# T = 2(M+P-1) ticks with exactly 2(P-1) idle ticks per stage — idle
+# fraction (P-1)/(M+P-1), the 1F1B bubble (pinned by
+# tests/test_moe_pipeline.py::TestOneFOneB::test_bubble_accounting).
+# Microbatch i's input activation is stashed from its F tick to its B tick;
+# at stage s that window holds at most P-s microbatches, so a P-slot ring
+# buffer (indexed i mod P) suffices — O(P) activation memory, the whole
+# point of 1F1B over end-to-end GPipe's O(M).
+#
+# The backward recomputes the stage forward from the stashed INPUT via
+# jax.vjp at the B tick (activation recompute, the standard large-model
+# setting) — VJP closures cannot live in a scan carry.  Gradients are
+# accumulated in the carry and the function returns them directly
+# (value-and-grad style); callers wrap it in jax.custom_vjp to splice the
+# manual grads into an outer autodiff (models/bert_pipeline.py).
+
+def schedule_table(n_stages: int, num_microbatches: int) -> list:
+    """The 1F1B slot table as plain data — SAME predicate arithmetic as
+    ``pipeline_1f1b``'s tick_fn, in python ints, so tests can pin the
+    schedule's structural claims (bubble fraction, O(P) stash occupancy,
+    neighbor-message timing) without tracing.  Returns
+    ``table[t][s] = ("F"|"B", mb_index) | None``."""
+    n, m = n_stages, num_microbatches
+    ticks = 2 * (m + n - 1)
+    table = []
+    for t in range(ticks):
+        row = []
+        for s in range(n):
+            f_num = t - s
+            b_num = t - (2 * n - 1 - s)
+            op = None
+            if f_num >= 0 and f_num % 2 == 0 and f_num // 2 < m:
+                op = ("F", f_num // 2)
+            if b_num >= 0 and b_num % 2 == 0 and b_num // 2 < m:
+                assert op is None, "F/B collision — parity argument broken"
+                op = ("B", b_num // 2)
+            row.append(op)
+        table.append(row)
+    return table
+
+
+def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
+                  last_params: Any, microbatches, mb_aux: Any,
+                  axis: str = "pipe"):
+    """Interleaved one-forward-one-backward pipeline schedule.
+
+    Inside ``shard_map`` with ``axis`` in scope.  Per pipe shard:
+
+    - ``stage_fn(sp, x, mb_idx) -> y``: this shard's stage.
+    - ``last_fn(lp, y, aux_i) -> scalar``: microbatch i's loss contribution
+      (already globally normalized so contributions SUM to the loss);
+      evaluated only on the last stage's shard.
+    - ``stage_params``: this shard's stage parameters.
+    - ``last_params``: replicated head/loss parameters.
+    - ``microbatches``: (M, mb, ...) — the SAME full stream on every pipe
+      shard.  ``mb_aux``: pytree with leading M axis (labels/masks/...).
+
+    Returns ``(loss, d_stage_params, d_last_params, d_microbatches)`` —
+    loss/d_last/d_micro are summed over ``axis`` (zeros contributed by
+    non-owning stages), d_stage_params is this shard's own stage grads.
+    """
+    n = lax.axis_size(axis)
+    s_idx = lax.axis_index(axis)
+    m = microbatches.shape[0]
+    ticks = 2 * (m + n - 1)
+    x_shape = microbatches.shape[1:]
+    f32 = jnp.float32
+
+    def fwd_branch(carry_in):
+        fwd_msg, stash, i_f = carry_in
+        x_in = jnp.where(s_idx == 0,
+                         microbatches[i_f].astype(fwd_msg.dtype), fwd_msg)
+        y = stage_fn(stage_params, x_in, i_f)
+        stash = lax.dynamic_update_index_in_dim(
+            stash, x_in, i_f % n, 0)
+        return y, stash
+
+    def tick_fn(carry, t):
+        fwd_msg, bwd_msg, stash, gs, gl, loss, dx_out = carry
+        # forward: stage s runs microbatch (t-s)/2 when parity and range fit
+        f_num = t - s_idx
+        i_f = jnp.clip(f_num // 2, 0, m - 1)
+        f_on = (f_num >= 0) & (f_num % 2 == 0) & (f_num // 2 < m)
+        y, stash = lax.cond(
+            f_on,
+            lambda c: fwd_branch(c),
+            lambda c: (jnp.zeros(x_shape, fwd_msg.dtype), c[1]),
+            (fwd_msg, stash, i_f))
+
+        # backward: stage s runs microbatch (t-(2n-1-s))/2
+        b_num = t - (2 * n - 1 - s_idx)
+        i_b = jnp.clip(b_num // 2, 0, m - 1)
+        b_on = (b_num >= 0) & (b_num % 2 == 0) & (b_num // 2 < m)
+
+        def bwd_branch(c):
+            bwd_msg, stash, gs, gl, loss, dx_out, i_b = c
+            x = stash[i_b % n]
+            yb, vjp_fn = jax.vjp(
+                lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
+
+            def last_stage(args):
+                yb, gl, loss = args
+                aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
+                li, last_vjp = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
+                dlp, dy = last_vjp(jnp.ones((), li.dtype))
+                return dy, jax.tree.map(jnp.add, gl, dlp), loss + li
+
+            def mid_stage(args):
+                yb, gl, loss = args
+                return bwd_msg.astype(yb.dtype), gl, loss
+
+            dy, gl, loss = lax.cond(s_idx == n - 1, last_stage, mid_stage,
+                                    (yb, gl, loss))
+            dsp, dx = vjp_fn(dy)
+            gs = jax.tree.map(jnp.add, gs, dsp)
+            # only stage 0's input cotangents are the embedding stream's
+            dx_out = lax.cond(
+                s_idx == 0,
+                lambda d: lax.dynamic_update_index_in_dim(
+                    d, dx.astype(f32), i_b, 0),
+                lambda d: d, dx_out)
+            return dx.astype(fwd_msg.dtype), stash, gs, gl, loss, dx_out
+
+        dx_send, stash, gs, gl, loss, dx_out = lax.cond(
+            b_on, bwd_branch,
+            lambda c: (jnp.zeros(x_shape, fwd_msg.dtype),) + c[1:6],
+            (bwd_msg, stash, gs, gl, loss, dx_out, i_b))
+
+        perm_f = [(j, (j + 1) % n) for j in range(n)]
+        perm_b = [(j, (j - 1) % n) for j in range(n)]
+        fwd_msg = lax.ppermute(y, axis, perm_f)
+        bwd_msg = lax.ppermute(dx_send, axis, perm_b)
+        return (fwd_msg, bwd_msg, stash, gs, gl, loss, dx_out), None
+
+    zero_like_local = lambda tree: jax.tree.map(
+        lambda x: jnp.zeros(jnp.shape(x), f32), tree)
+    # seed the messages/stash from the stream so they inherit its
+    # varying-axes type under shard_map's type checks
+    seed = jnp.sum(microbatches[:1]) * 0
+    init = (
+        jnp.zeros(x_shape, microbatches.dtype) + seed,
+        jnp.zeros(x_shape, microbatches.dtype) + seed,
+        jnp.zeros((n,) + x_shape, microbatches.dtype) + seed,
+        zero_like_local(stage_params),
+        zero_like_local(last_params),
+        jnp.zeros((), f32),
+        jnp.zeros((m,) + x_shape, f32) + seed,
+    )
+    (_, _, _, gs, gl, loss, dx_out), _ = lax.scan(
+        tick_fn, init, jnp.arange(ticks))
+    # loss/gl/dx_out live on one stage each (zeros elsewhere): sum the ring
+    loss = lax.psum(loss, axis)
+    gl = jax.tree.map(lambda x: lax.psum(x, axis), gl)
+    dx_out = lax.psum(dx_out, axis)
+    return loss, gs, gl, dx_out
+
+
 def make_pipelined_fn(stage_fn: Callable, mesh: Mesh,
                       num_microbatches: int, axis: str = "pipe"):
     """jit-ready wrapper: ``f(stacked_params, batch) -> out``.

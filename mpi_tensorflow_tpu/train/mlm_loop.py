@@ -59,7 +59,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     elif mesh.shape.get("pipe", 1) > 1:
         from mpi_tensorflow_tpu.models import bert_pipeline
 
-        model = bert_pipeline.PipelinedBertMlm(bert_cfg, mesh=mesh)
+        model = bert_pipeline.PipelinedBertMlm(
+            bert_cfg, mesh=mesh, schedule=config.pp_schedule)
     else:
         model = bert.BertMlm(bert_cfg, mesh=mesh)
 
