@@ -75,8 +75,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "trade recompute FLOPs for peak activation HBM")
     p.add_argument("--text-file", default=None,
                    help="train the LM families on a local text file "
-                        "(byte-level tokenizer, data/corpus.py) instead of "
-                        "the synthetic stream")
+                        "(data/corpus.py) instead of the synthetic stream")
+    p.add_argument("--vocab-file", default=None,
+                   help="WordPiece vocabulary for --text-file (one token "
+                        "per line, BERT vocab.txt layout); default: "
+                        "self-contained byte-level tokenizer (vocab 261)")
     p.add_argument("--max-restarts", type=int, default=0,
                    help="elastic recovery: restart from the latest "
                         "checkpoint after transient infrastructure "
@@ -121,6 +124,7 @@ def config_from_args(args) -> Config:
         sync=args.sync, seed=args.seed, data_dir=args.data_dir,
         model=args.model, dataset=args.dataset,
         mesh_shape=parse_mesh(args.mesh), text_file=args.text_file,
+        vocab_file=args.vocab_file,
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         precision=args.precision, grad_accum=args.grad_accum,
         pp_schedule=args.pp_schedule,
@@ -146,6 +150,8 @@ def main(argv=None) -> int:
             f"--text-file applies to the language-model families "
             f"(bert_base, moe_bert, gpt_base); --model {config.model} "
             f"would silently ignore it")
+    if config.vocab_file and not config.text_file:
+        raise SystemExit("--vocab-file only applies with --text-file")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

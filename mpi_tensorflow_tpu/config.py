@@ -62,8 +62,12 @@ class Config:
                                   # (jax.checkpoint): recompute activations
                                   # in the backward pass to cut peak HBM
     text_file: Optional[str] = None  # real-text corpus for the LM families
-                                  # (data/corpus.py byte-level tokenizer);
-                                  # None = the synthetic stream
+                                  # (data/corpus.py); None = synthetic
+    vocab_file: Optional[str] = None  # WordPiece vocab (one token/line,
+                                  # BERT vocab.txt layout) for --text-file
+                                  # runs: real-vocab training exercises the
+                                  # packed/chunked MLM head at flagship
+                                  # vocab size; None = byte-level (261)
     prefetch: str = "auto"        # window-assembly prefetch for the fused
                                   # loop: "auto" (native C++ worker when
                                   # built, else Python thread), "native",

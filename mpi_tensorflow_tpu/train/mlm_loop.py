@@ -48,6 +48,15 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
         bert_cfg = dc.replace(bert.BERT_BASE, dtype=config.compute_dtype,
                               remat=config.remat)
+    wp_vocab = None
+    if getattr(config, "text_file", None) and \
+            getattr(config, "vocab_file", None):
+        from mpi_tensorflow_tpu.data import corpus
+
+        # real vocabulary: the model's vocab axis adopts its size, so the
+        # packed/chunked head trains at the true (e.g. 30522) width
+        wp_vocab = corpus.WordPieceVocab.from_file(config.vocab_file)
+        bert_cfg = dataclasses.replace(bert_cfg, vocab_size=wp_vocab.size)
     if config.model == "moe_bert":
         from mpi_tensorflow_tpu.models import moe
 
@@ -65,17 +74,19 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         model = bert.BertMlm(bert_cfg, mesh=mesh)
 
     if getattr(config, "text_file", None):
-        # real text via the byte-level tokenizer (data/corpus.py); the
-        # trailing rows become the held-out split
+        # real text, byte-level or WordPiece per --vocab-file
+        # (data/corpus.py); the trailing rows become the held-out split
         from mpi_tensorflow_tpu.data import corpus
 
         if getattr(model, "causal", False):
-            rows = corpus.load_causal(config.text_file, seq_len=seq_len)
+            rows = corpus.load_causal(config.text_file, seq_len=seq_len,
+                                      vocab_file=wp_vocab)
             inp, tgt_all = rows, rows
             msk = np.ones(rows.shape, bool)
         else:
             inp, tgt_all, msk = corpus.load_mlm(
-                config.text_file, seq_len=seq_len, seed=config.seed)
+                config.text_file, seq_len=seq_len, seed=config.seed,
+                vocab_file=wp_vocab)
         n_test = max(len(inp) // 10, 1)
         train_n, test_n = len(inp) - n_test, n_test
         tokens, targets, mask = (inp[:train_n], tgt_all[:train_n],
