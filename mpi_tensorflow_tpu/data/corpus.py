@@ -121,8 +121,11 @@ class WordPieceVocab:
                 pieces.append(piece_id)
                 pos = end
             if pieces is None:
-                if self.unk is not None:
-                    ids.append(self.unk)
+                if self.unk is None:
+                    raise ValueError(
+                        f"word {word!r} has no WordPiece match and the "
+                        f"vocab has no [UNK] token to fall back to")
+                ids.append(self.unk)
             else:
                 ids.extend(pieces)
         return np.asarray(ids, np.int32)
@@ -136,7 +139,27 @@ def sequences_from_file(path: str, *, seq_len: int,
     mpipy.py:211-213).  ``vocab``: WordPiece encoding; None = byte-level."""
     with open(path, "rb") as f:
         raw = f.read()
-    ids = vocab.encode(raw) if vocab is not None else encode_bytes(raw)
+    if vocab is None:
+        ids = encode_bytes(raw)
+    elif max_sequences is None:
+        ids = vocab.encode(raw)
+    else:
+        # stream line-by-line and stop once enough ids exist: WordPiece
+        # encoding is a per-character python loop, so encoding a huge
+        # corpus only to truncate to max_sequences rows would waste
+        # minutes of single-core time (words never span newlines, so
+        # line-wise encoding equals whole-file encoding)
+        need = max_sequences * seq_len
+        parts, total = [], 0
+        for line in raw.decode("utf-8", errors="replace").splitlines():
+            enc = vocab.encode(line)
+            if len(enc):
+                parts.append(enc)
+                total += len(enc)
+            if total >= need:
+                break
+        ids = (np.concatenate(parts) if parts
+               else np.zeros((0,), np.int32))
     n = len(ids) // seq_len
     if max_sequences is not None:
         n = min(n, max_sequences)

@@ -41,13 +41,39 @@ _TRANSIENT_MARKERS = ("device_lost", "device lost", "unavailable",
 _PERMANENT_MARKERS = ("resource_exhausted", "out of memory", "oom",
                       "invalid_argument", "unimplemented", "failed_precond")
 
+# canonical absl/gRPC status codes, the stable contract PJRT errors carry
+# ("UNAVAILABLE: socket closed ...") — classified FIRST, before any
+# free-text matching, so a reworded message body cannot flip the verdict.
+# UNKNOWN is deliberately in neither set: it is gRPC's catch-all for
+# arbitrary server-side exceptions (often a peer's deterministic bug), so
+# it falls through to the substring heuristics instead of force-retrying
+_TRANSIENT_CODES = frozenset({"UNAVAILABLE", "ABORTED", "DEADLINE_EXCEEDED",
+                              "CANCELLED"})
+_PERMANENT_CODES = frozenset({"RESOURCE_EXHAUSTED", "INVALID_ARGUMENT",
+                              "UNIMPLEMENTED", "FAILED_PRECONDITION",
+                              "NOT_FOUND", "ALREADY_EXISTS", "OUT_OF_RANGE",
+                              "PERMISSION_DENIED", "UNAUTHENTICATED"})
+
+
+def _status_code(e: BaseException) -> Optional[str]:
+    """Leading canonical status code of a PJRT/RPC error message, if any."""
+    head = str(e).split(":", 1)[0].strip().upper().replace(" ", "_")
+    if head in _TRANSIENT_CODES or head in _PERMANENT_CODES:
+        return head
+    return None
+
 
 def is_transient(e: BaseException) -> bool:
-    """Worth retrying?  OS/connection errors yes; RuntimeErrors only when
-    the message looks like infrastructure (device loss / RPC / preemption)
-    rather than a deterministic program failure (OOM, invalid argument)."""
+    """Worth retrying?  Classified by exception TYPE first (OS/connection
+    errors), then by the canonical status code PJRT errors carry, and only
+    then by message substrings — so the free-text fallback cannot override
+    a structured verdict, and a reworded device-loss message still retries
+    as long as its status code survives."""
     if isinstance(e, (OSError, ConnectionError)):
         return True
+    code = _status_code(e)
+    if code is not None:
+        return code in _TRANSIENT_CODES
     msg = str(e).lower()
     if any(m in msg for m in _PERMANENT_MARKERS):
         return False

@@ -20,11 +20,14 @@ from mpi_tensorflow_tpu.ops.flash_attention import kernel_supported
 print({d: {c: kernel_supported(d, c) for c in (False, True)}
        for d in ('bfloat16', 'float32')})" 2>/dev/null | tail -1 >> "$LOG"
 
-# 1. flagship BERT CE-variant sweep (config 5)
+# 1. flagship BERT CE-variant sweep (config 5); every artifact's detail
+# now records which attention/CE paths actually engaged (utils/engagement)
 run python bench.py --model bert_base --precision bf16
 run python bench.py --model bert_base --precision bf16 --ce chunked
 run python bench.py --model bert_base --precision bf16 --ce dense
 run python bench.py --model bert_base --precision bf16 --params-bf16
+# flash-vs-XLA A/B: the control arm forces the XLA attention fallback
+run env MPI_TF_TPU_DISABLE_FLASH=1 python bench.py --model bert_base --precision bf16
 
 # 2. ResNet-50 batch/remat sweep (config 4; target >= 2x 1328 img/s)
 run python bench.py --model resnet50 --precision bf16

@@ -243,3 +243,17 @@ class TestFlagshipVocab:
             random_ids=v.random_replacement_ids(), seed=0)
         changed = inp[mask]
         assert set(np.unique(changed)) <= {2, 4, 5}   # [MASK] or non-special
+
+    def test_missing_unk_fails_fast(self):
+        v = corpus.WordPieceVocab(["[PAD]", "[MASK]", "hello"])
+        with pytest.raises(ValueError, match="no .UNK."):
+            v.encode("hello stranger")
+
+    def test_streamed_max_sequences_matches_full_encode(self, tmp_path):
+        v = corpus.WordPieceVocab(["[PAD]", "[UNK]", "[MASK]", "aa", "bb"])
+        p = tmp_path / "big.txt"
+        p.write_text("\n".join("aa bb aa" for _ in range(200)))
+        full = corpus.sequences_from_file(str(p), seq_len=8, vocab=v)
+        part = corpus.sequences_from_file(str(p), seq_len=8,
+                                          max_sequences=3, vocab=v)
+        np.testing.assert_array_equal(part, full[:3])

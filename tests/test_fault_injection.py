@@ -1,0 +1,154 @@
+"""Fault injection through a REAL process (VERDICT r2 #9).
+
+The round-2 recovery tests simulated failures by raising exceptions inside
+the process; this launches the actual CLI in a subprocess, SIGKILLs it
+mid-run (no grace, no signal handler — the crash-durability path, not the
+preemption path), and relaunches with --resume, asserting the run
+continues from the last COMMITTED checkpoint step.
+
+Also pins the status-code-first transient classification
+(train/elastic.py): the canonical gRPC/absl code a PJRT error carries
+decides retry-vs-fail before any message substring can.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.train import checkpoint, elastic
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestTransientClassification:
+    pytestmark = pytest.mark.quick
+
+    def test_status_code_beats_substring(self):
+        # body mentions "invalid_argument", but the structured code says
+        # UNAVAILABLE -> retry
+        e = RuntimeError("UNAVAILABLE: peer rejected invalid_argument blob")
+        assert elastic.is_transient(e)
+        # and the reverse: a permanent code with chatty transient words
+        e = RuntimeError("RESOURCE_EXHAUSTED: connection pool preempted")
+        assert not elastic.is_transient(e)
+
+    def test_reworded_message_with_code_still_retries(self):
+        # the round-2 hazard: a reworded device-loss message; the code
+        # prefix is the stable contract
+        assert elastic.is_transient(RuntimeError(
+            "ABORTED: some brand new wording nobody grepped for"))
+
+    def test_type_first(self):
+        assert elastic.is_transient(ConnectionResetError("whatever"))
+        assert elastic.is_transient(OSError("anything at all"))
+
+    def test_plain_runtime_error_falls_back_to_substrings(self):
+        assert elastic.is_transient(RuntimeError("device lost mid-step"))
+        assert not elastic.is_transient(RuntimeError("shape mismatch (4,)"))
+
+    def test_unknown_code_falls_through_to_substrings(self):
+        # UNKNOWN is gRPC's catch-all for peer-side bugs: it must NOT
+        # force a retry; the substring heuristics decide
+        assert not elastic.is_transient(
+            RuntimeError("UNKNOWN: invalid_argument in peer handler"))
+        assert elastic.is_transient(
+            RuntimeError("UNKNOWN: socket connection dropped"))
+
+
+def _cli_env():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # force local CPU backend
+    env["JAX_PLATFORMS"] = "cpu"
+    env["JAX_NUM_CPU_DEVICES"] = "8"
+    env["PYTHONUNBUFFERED"] = "1"
+    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    return env
+
+
+def _launch(args, env):
+    return subprocess.Popen(
+        [sys.executable, "-m", "mpi_tensorflow_tpu"] + args,
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+
+
+def _read_until(proc, pred, deadline_s):
+    """Collect stdout lines until ``pred(lines)`` or deadline/exit."""
+    lines = []
+    t0 = time.time()
+    while time.time() - t0 < deadline_s:
+        line = proc.stdout.readline()
+        if line:
+            lines.append(line.rstrip("\n"))
+            if pred(lines):
+                return lines, True
+        elif proc.poll() is not None:
+            break
+    return lines, False
+
+
+class TestSigkillResume:
+    def test_sigkill_mid_run_then_resume(self, tmp_path):
+        """Kill -9 the training process after checkpoints commit; the
+        relaunch must resume from the committed step and run to
+        completion with the step counter continuing past it."""
+        from mpi_tensorflow_tpu.data import mnist
+
+        data = tmp_path / "mnist"
+        data.mkdir()
+        mnist._write_synthetic(str(data), train_n=7400, test_n=1024)
+        ckpt = str(tmp_path / "ckpt")
+        env = _cli_env()
+        # --fused-steps aligned to --log-every: ONE window shape -> one
+        # multi-step compile (distinct widths would each pay a multi-minute
+        # CPU compile on a 1-core host)
+        common = ["--data-dir", str(data), "--checkpoint-dir", ckpt,
+                  "--epochs", "10", "--log-every", "10",
+                  "--fused-steps", "10"]
+
+        proc = _launch(common, env)
+        try:
+            def traced(lines):
+                # 3 DISTINCT trace points (each prints one line per shard);
+                # by the 3rd, the 1st's async save has been drained durable
+                # by the 2nd's and committed
+                steps = {ln.split("at")[1].split("with")[0].strip()
+                         for ln in lines if "with test error" in ln}
+                return len(steps) >= 3
+
+            lines, ok = _read_until(proc, traced, deadline_s=1500)
+            assert ok, "never reached 3 trace points:\n" + "\n".join(lines)
+            # no grace: the crash-durability path, not preemption handling
+            proc.send_signal(signal.SIGKILL)
+            proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        committed = checkpoint.latest_step(ckpt)
+        assert committed is not None and committed >= 10, committed
+
+        # relaunch with just enough epochs to pass the committed step and
+        # finish quickly (4 steps/epoch at this split: 2400/8 rows, b=64)
+        epochs2 = (committed + 1) // 4 + 3
+        proc2 = _launch(["--data-dir", str(data), "--checkpoint-dir", ckpt,
+                         "--epochs", str(epochs2), "--log-every", "10",
+                         "--fused-steps", "10",
+                         "--resume", "--max-restarts", "1"], env)
+        try:
+            out, _ = proc2.communicate(timeout=1500)
+        finally:
+            if proc2.poll() is None:
+                proc2.kill()
+        assert proc2.returncode == 0, out
+        assert f"[checkpoint] resumed from step {committed}" in out, out
+        # loss continuity: the resumed trace continues past the committed
+        # step instead of restarting at step 0
+        steps = [int(ln.split("at")[1].split("with")[0])
+                 for ln in out.splitlines() if "with test error" in ln]
+        assert steps and min(steps) > committed, (committed, steps)
