@@ -125,21 +125,28 @@ def qkv_proj(lp, h, dt):
     return q, k, v
 
 
-def attn_out_proj(lp, a, dt):
-    """Row-parallel attention output projection: (B, H, S, D) -> (B, S, E)."""
-    return jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt)) \
-        + lp["bo"].astype(dt)
+def attn_out_proj(lp, a, dt, reduce=None):
+    """Row-parallel attention output projection: (B, H, S, D) -> (B, S, E).
+    ``reduce``: applied to the partial product BEFORE the bias — the
+    manual-TP psum hook (the bias must be added exactly once)."""
+    out = jnp.einsum("bhsd,hde->bse", a, lp["wo"].astype(dt))
+    if reduce is not None:
+        out = reduce(out)
+    return out + lp["bo"].astype(dt)
 
 
-def gelu_mlp(lp, h, dt, constrain=None):
+def gelu_mlp(lp, h, dt, constrain=None, reduce=None):
     """Position-wise GELU MLP; ``constrain`` optionally annotates the
-    (B, S, mlp) intermediate with sharding."""
+    (B, S, mlp) intermediate with sharding; ``reduce`` is the manual-TP
+    psum hook on the row-parallel output (pre-bias)."""
     m = jax.nn.gelu(jnp.einsum("bse,ef->bsf", h, lp["w1"].astype(dt))
                     + lp["b1"].astype(dt))
     if constrain is not None:
         m = constrain(m)
-    return jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt)) \
-        + lp["b2"].astype(dt)
+    out = jnp.einsum("bsf,fe->bse", m, lp["w2"].astype(dt))
+    if reduce is not None:
+        out = reduce(out)
+    return out + lp["b2"].astype(dt)
 
 
 @dataclasses.dataclass(frozen=True)

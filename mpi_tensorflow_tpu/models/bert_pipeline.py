@@ -135,12 +135,18 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                                      for kk, vv in v.items()}
         return axes
 
-    def _plain_layer(self, lp, h, drop=None):
+    def _plain_layer(self, lp, h, drop=None, tp_axis=None):
         """One encoder layer with no mesh constraints — runs inside the
         pipe ``shard_map`` where GSPMD annotations are unavailable.  Same
         math as BertMlm's layer.  ``drop``: ``None`` (eval / dropout off) or
         a ``site -> key`` function yielding this layer's per-site dropout
-        keys (already folded on microbatch and global layer index)."""
+        keys (already folded on microbatch and global layer index).
+
+        ``tp_axis``: Megatron tensor parallelism INSIDE the stage — the
+        stage's heads/MLP-hidden arrive sharded over that mesh axis
+        (column-parallel in), and the two row-parallel output projections
+        are manually ``psum``'d; biases of the row-parallel outputs are
+        added once, after the reduction."""
         dt = self.cfg.dtype
 
         def dropout(x, site):
@@ -148,11 +154,13 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 return x
             return bert_lib.dropout_mask(x, self.cfg.dropout, drop(site))
 
-        q, k, v = bert_lib.qkv_proj(lp, h, dt)
+        reduce = None if tp_axis is None else \
+            (lambda x: lax.psum(x, tp_axis))
+        q, k, v = bert_lib.qkv_proj(lp, h, dt)   # local head subset if TP
         a = ring.dense_attention(q, k, v)
-        a = bert_lib.attn_out_proj(lp, a, dt)
+        a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
         h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)
-        m = bert_lib.gelu_mlp(lp, h, dt)
+        m = bert_lib.gelu_mlp(lp, h, dt, reduce=reduce)
         return _layernorm(h + dropout(m, 1), lp["ln2"]).astype(dt)
 
     def _dropping(self, train: bool, rng) -> bool:
@@ -163,7 +171,7 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         return True
 
     def _stage(self, stage_params, x, rng=None, mb_idx=None,
-               stage_idx=None):
+               stage_idx=None, tp_axis=None):
         """Run this stage's L/P layers sequentially (scan over the layer
         dim of the stacked params).  When ``rng`` is set, dropout keys are
         folded on (microbatch, global layer, site) so every microbatch at
@@ -179,7 +187,8 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 gl = stage_idx * Lp + li      # global layer index
                 kb = jax.random.fold_in(jax.random.fold_in(rng, mb_idx), gl)
                 drop = lambda site: jax.random.fold_in(kb, site)  # noqa: E731
-            return self._plain_layer(lp, h, drop=drop), None
+            return self._plain_layer(lp, h, drop=drop,
+                                     tp_axis=tp_axis), None
 
         if self.cfg.remat:
             # recompute stage activations in the backward pipeline: the
@@ -225,32 +234,49 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 f"per-data-shard batch {B // dp} not divisible by "
                 f"{M} microbatches")
         h_spec = P("data" if dp > 1 else None)
+        tp_axis = "model" if self.mesh.shape.get("model", 1) > 1 else None
 
         def inner(stacked_local, hl, key):
             stage_params = jax.tree.map(lambda x: x[0], stacked_local)
             mb = hl.reshape((M, hl.shape[0] // M) + hl.shape[1:])
             if dropping:
                 # decorrelate the data shards' masks too (each data shard
-                # pipelines a different slice of the global batch)
+                # pipelines a different slice of the global batch); model
+                # shards keep the SAME key — their outputs are replicated
                 key = jax.random.fold_in(
                     key, lax.axis_index("data") if dp > 1 else 0)
                 sidx = lax.axis_index("pipe")
                 out = pipeline_lib.pipeline(
                     lambda p, x, mi: self._stage(p, x, rng=key, mb_idx=mi,
-                                                 stage_idx=sidx),
+                                                 stage_idx=sidx,
+                                                 tp_axis=tp_axis),
                     stage_params, mb, "pipe", with_mb_index=True)
             else:
                 out = pipeline_lib.pipeline(
-                    lambda p, x: self._stage(p, x), stage_params, mb, "pipe")
+                    lambda p, x: self._stage(p, x, tp_axis=tp_axis),
+                    stage_params, mb, "pipe")
             return out.reshape(hl.shape)
 
         key = rng if dropping else jax.random.key(0)
         h = jax.shard_map(
             inner, mesh=self.mesh,
-            in_specs=(P("pipe"), h_spec, P()), out_specs=h_spec,
+            in_specs=(self._stage_param_specs(), h_spec, P()),
+            out_specs=h_spec,
             check_vma=False)(params["layers"], h, key)
         h = self._constrain(h, ("batch", "seq", "embed"))
         return h, jnp.zeros((), jnp.float32)
+
+    def _stage_param_specs(self):
+        """Per-leaf shard_map in_specs for the stacked stage params: the
+        rule-table layout (stage -> pipe, heads/mlp -> model when the mesh
+        has a model axis) — the specs must tell shard_map the truth about
+        how ``shard_tree``/GSPMD placed the parameters, or TP-inside-stage
+        would silently gather."""
+        from mpi_tensorflow_tpu.parallel import sharding_rules
+
+        return sharding_rules.tree_specs(
+            self.logical_axes()["layers"], self.mesh,
+            self.rules)
 
     # ------------------------------------------------------------------
     # interleaved 1F1B training path
@@ -284,6 +310,12 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             bert_lib.engagement.record("pp_schedule", "gpipe")
             return super().loss(params, model_state, batch, labels,
                                 rng=rng, train=train)
+        if self.mesh.shape.get("model", 1) > 1:
+            raise NotImplementedError(
+                "schedule='1f1b' does not yet compose with tensor "
+                "parallelism inside stages (the in-schedule head/CE would "
+                "need a vocab-parallel logsumexp); use schedule='gpipe' "
+                "for pipe x model meshes")
         bert_lib.engagement.record("pp_schedule", "1f1b")
 
         c = self.cfg
