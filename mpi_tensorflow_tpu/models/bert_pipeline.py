@@ -12,8 +12,10 @@ a ``BertMlm`` whose encoder calls ``parallel.pipeline.pipeline`` inside a
 ``shard_map``; reverse-mode autodiff of the scanned schedule yields the
 backward pipeline (reverse ``ppermute`` hops) automatically.
 
-Composition: ``pipe x data`` (each data shard runs its own microbatch
-stream through the stages).  The loss-side machinery (masked-position
+Composition: ``pipe x model x data`` — each data shard runs its own
+microbatch stream through the stages, and when the mesh has a ``model``
+axis the per-stage compute is Megatron tensor-parallel (heads/MLP-hidden
+column-parallel in, manual row-parallel psums; ``_plain_layer`` tp_axis).  The loss-side machinery (masked-position
 packing, chunked CE) is inherited.  Dropout trains unmodified: the
 schedule hands each stage the index of the microbatch it is processing
 (parallel/pipeline.py ``with_mb_index``), and dropout keys are folded on
@@ -35,7 +37,9 @@ Memory schedules, from cheapest to most capable:
   stashed input).  Loss/grad parity with GPipe is pinned by
   tests/test_moe_pipeline.py::TestOneFOneB.
 ``cfg.remat`` additionally recomputes within-stage activations in the
-backward.  TP/SP inside a stage remains future work.
+backward.  TP inside a stage works with both schedules (the 1F1B path
+runs a vocab-parallel CE in-schedule); SP inside a stage remains future
+work.
 
 No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
 """
@@ -282,10 +286,15 @@ class PipelinedBertMlm(bert_lib.BertMlm):
     # interleaved 1F1B training path
     # ------------------------------------------------------------------
 
-    def _mb_loss(self, head_params, y, labels_i, mask_i, inv):
+    def _mb_loss(self, head_params, y, labels_i, mask_i, inv,
+                 tp_axis=None):
         """Microbatch loss contribution (already globally normalized by
         ``inv`` = 1/total masked count, so contributions SUM to the same
-        loss the GPipe path computes).  Runs on the last stage only."""
+        loss the GPipe path computes).  Runs on the last stage only.
+
+        ``tp_axis``: the vocab decoder (``tok_emb``/``out_b``) arrives
+        vocab-sharded over that axis — CE then goes through the sharded
+        logsumexp in ``_vocab_parallel_ce``."""
         c = self.cfg
         if c.ce_positions == "masked":
             from mpi_tensorflow_tpu.ops import mlm_head
@@ -295,14 +304,46 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 y, labels_i, mask_i.astype(jnp.bool_),
                 bert_lib.ce_capacity(c, y.shape[1]))
             t = self.head_hidden(head_params, packed)
-            ce = self._ce(head_params, t, plab)
+            ce = self._vocab_parallel_ce(head_params, t, plab, tp_axis) \
+                if tp_axis is not None else self._ce(head_params, t, plab)
             weights = w
         else:
             bert_lib.engagement.record("ce_positions", "all")
             t = self.head_hidden(head_params, y)
-            ce = self._ce(head_params, t, labels_i)
+            ce = self._vocab_parallel_ce(head_params, t, labels_i, tp_axis) \
+                if tp_axis is not None \
+                else self._ce(head_params, t, labels_i)
             weights = mask_i.astype(jnp.float32)
         return jnp.sum(ce * weights) * inv
+
+    def _vocab_parallel_ce(self, head_params, t, labels, tp_axis):
+        """Tied-decoder CE with the vocab axis sharded over ``tp_axis``
+        (manual collectives — runs inside the 1F1B shard_map where GSPMD
+        is unavailable).  Each shard scores its local vocab slice; the
+        softmax statistics and the gold logit are reduced across shards:
+        logz = log(psum(sum(exp(l - pmax)))) + pmax, and the gold logit is
+        psum of the one shard that owns the label's row."""
+        dt = self.cfg.dtype
+        logits = jnp.einsum("bse,ve->bsv", t,
+                            head_params["tok_emb"].astype(dt)) \
+            + head_params["mlm"]["out_b"]
+        logits = logits.astype(jnp.float32)
+        v_loc = logits.shape[-1]
+        lo = lax.axis_index(tp_axis) * v_loc
+        # the max is numerical stabilization only (it cancels exactly in
+        # logz's gradient) — detached; pmax has no differentiation rule,
+        # so the cross-shard max goes through all_gather (which has one)
+        m = lax.stop_gradient(jnp.max(
+            lax.all_gather(jnp.max(logits, axis=-1), tp_axis, axis=0),
+            axis=0))
+        se = lax.psum(jnp.sum(jnp.exp(logits - m[..., None]), axis=-1),
+                      tp_axis)
+        logz = jnp.log(se) + m
+        in_range = (labels >= lo) & (labels < lo + v_loc)
+        loc = jnp.clip(labels - lo, 0, v_loc - 1)
+        gold_loc = jnp.take_along_axis(logits, loc[..., None], axis=-1)[..., 0]
+        gold = lax.psum(jnp.where(in_range, gold_loc, 0.0), tp_axis)
+        return logz - gold
 
     def loss(self, params, model_state, batch, labels, *, rng=None,
              train: bool = False):
@@ -310,12 +351,6 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             bert_lib.engagement.record("pp_schedule", "gpipe")
             return super().loss(params, model_state, batch, labels,
                                 rng=rng, train=train)
-        if self.mesh.shape.get("model", 1) > 1:
-            raise NotImplementedError(
-                "schedule='1f1b' does not yet compose with tensor "
-                "parallelism inside stages (the in-schedule head/CE would "
-                "need a vocab-parallel logsumexp); use schedule='gpipe' "
-                "for pipe x model meshes")
         bert_lib.engagement.record("pp_schedule", "1f1b")
 
         c = self.cfg
@@ -336,10 +371,29 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         head_params = {"mlm": params["mlm"], "tok_emb": params["tok_emb"]}
         key = rng if dropping else jax.random.key(0)
         h_spec = P("data" if dp > 1 else None)
+        tp_axis = "model" if self.mesh.shape.get("model", 1) > 1 else None
         # the in-schedule head/CE math runs INSIDE shard_map, where GSPMD
         # sharding constraints are illegal — a mesh-free view of this model
         # computes the same math without annotations
         plain = dataclasses.replace(self, mesh=None)
+        from mpi_tensorflow_tpu.parallel import sharding_rules
+
+        axes = self.logical_axes()
+        hp_specs = sharding_rules.tree_specs(
+            {"mlm": axes["mlm"], "tok_emb": axes["tok_emb"]}, self.mesh,
+            self.rules)
+        sp_specs = self._stage_param_specs()
+
+        def _reduce_partials(grads, specs):
+            """Under manual vjp inside shard_map, a REPLICATED parameter's
+            cotangent comes back as per-model-shard partials whose sum is
+            the true grad; model-sharded leaves are already local-true.
+            Sum exactly the leaves whose spec does not mention the axis."""
+            if tp_axis is None:
+                return grads
+            return jax.tree.map(
+                lambda g, spec: g if tp_axis in spec
+                else lax.psum(g, tp_axis), grads, specs)
 
         def inner(stacked_local, hp, hl, labels_l, mask_l, inv, key):
             sp = jax.tree.map(lambda x: x[0], stacked_local)
@@ -354,14 +408,28 @@ class PipelinedBertMlm(bert_lib.BertMlm):
 
             def stage_fn(p, x, mi):
                 return self._stage(p, x, rng=key if dropping else None,
-                                   mb_idx=mi, stage_idx=sidx)
+                                   mb_idx=mi, stage_idx=sidx,
+                                   tp_axis=tp_axis)
 
             def last_fn(hp, y, aux):
                 labels_i, mask_i = aux
-                return plain._mb_loss(hp, y, labels_i, mask_i, inv)
+                return plain._mb_loss(hp, y, labels_i, mask_i, inv,
+                                      tp_axis=tp_axis)
 
             loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b(
                 stage_fn, last_fn, sp, hp, mb, (lab, msk), "pipe")
+            gl = _reduce_partials(gl, hp_specs)
+            gs = _reduce_partials(gs, sp_specs)
+            if tp_axis is not None:
+                dmb = lax.psum(dmb, tp_axis)   # h is model-replicated
+                # the microbatch loss is computed REPLICATED across model
+                # shards, so last_fn's vjp seeds the cotangent once per
+                # shard — every accumulated gradient carries a factor of
+                # tp; normalize once here (the loss VALUE is replicated,
+                # not summed, and needs no correction)
+                tp = self.mesh.shape["model"]
+                gs, gl, dmb = jax.tree.map(lambda x: x / tp,
+                                           (gs, gl, dmb))
             # sum loss/replicated-param grads over the data shards too
             # (each shard saw a different batch slice of the global mean)
             if dp > 1:
@@ -374,8 +442,9 @@ class PipelinedBertMlm(bert_lib.BertMlm):
 
         run = jax.shard_map(
             inner, mesh=self.mesh,
-            in_specs=(P("pipe"), P(), h_spec, h_spec, h_spec, P(), P()),
-            out_specs=(P(), P("pipe"), P(), h_spec),
+            in_specs=(sp_specs, hp_specs, h_spec, h_spec, h_spec,
+                      P(), P()),
+            out_specs=(P(), sp_specs, hp_specs, h_spec),
             check_vma=False)
 
         loss = _sched_loss(run, params["layers"], head_params, h, labels,

@@ -150,7 +150,12 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
       (already globally normalized so contributions SUM to the loss);
       evaluated only on the last stage's shard.
     - ``stage_params``: this shard's stage parameters.
-    - ``last_params``: replicated head/loss parameters.
+    - ``last_params``: head/loss parameters — replicated over ``axis``;
+      they MAY be sharded over other mesh axes (e.g. a vocab-parallel
+      decoder over ``model``), in which case ``last_fn`` owns the
+      cross-shard collectives and the caller owns the partial-cotangent
+      reductions on the returned grads (see bert_pipeline's
+      ``_reduce_partials``).
     - ``microbatches``: (M, mb, ...) — the SAME full stream on every pipe
       shard.  ``mb_aux``: pytree with leading M axis (labels/masks/...).
 
