@@ -42,6 +42,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_BIG = -1e30
 
+# Per-row statistics (logsumexp, delta) cannot leave/enter kernels as flat
+# (1, block_q) rows: Mosaic requires a block's sublane dim to be divisible
+# by 8 or equal to the array dim, which a 1-row block over a (B*H, S) array
+# violates whenever B*H > 1 (the round-2 probe shape hid exactly this).
+# The forward therefore EMITS lse lane-broadcast as (B*H, S, LSE_LANES)
+# and the backward CONSUMES it sublane-broadcast as (B*H, LSE_SUBLANES, S)
+# — the latter orientation puts q-position on lanes, so the transposed
+# (block_k, block_q) backward kernels read a native (1, block_q) row.
+LSE_LANES = 128      # official TPU flash kernel uses MIN_BLOCK_SIZE lanes
+LSE_SUBLANES = 8     # f32 sublane tile
+
 
 # ---------------------------------------------------------------------------
 # pure-JAX blockwise online softmax (portable fallback)
@@ -148,7 +159,11 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr,
         l = l_scr[:, 0:1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[...] = (m + jnp.log(l_safe)).reshape(1, block_q)
+        # lse leaves in a lane-broadcast (block_q, LSE_LANES) tile: Mosaic
+        # rejects blocks whose sublane dim is 1 over a larger array dim, so
+        # a flat (1, block_q) row per program cannot be written from here
+        lse_ref[0] = jnp.broadcast_to(m + jnp.log(l_safe),
+                                      lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
@@ -167,7 +182,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
     out, lse = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((B * H, S, Dv), q.dtype),
-                   jax.ShapeDtypeStruct((B * H, S), jnp.float32)),
+                   jax.ShapeDtypeStruct((B * H, S, LSE_LANES), jnp.float32)),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
@@ -180,7 +195,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
         out_specs=(
             pl.BlockSpec((1, block_q, Dv), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+            pl.BlockSpec((1, block_q, LSE_LANES), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM),
         ),
         scratch_shapes=[
@@ -190,7 +205,7 @@ def _flash_forward(q, k, v, *, causal: bool, scale: float, block_q: int,
         ],
         interpret=interpret,
     )(qf, kf, vf)
-    return out.reshape(B, H, S, Dv), lse.reshape(B, H, S)
+    return out.reshape(B, H, S, Dv), lse[:, :, 0].reshape(B, H, S)
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +262,7 @@ def _flash_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
     def _step():
         _, ds_t = _scores_t(
             k_ref[0], q_ref[0], v_ref[0], do_ref[0],
-            lse_ref[...], dsum_ref[...], scale=scale, causal=causal,
+            lse_ref[0, 0:1], dsum_ref[0, 0:1], scale=scale, causal=causal,
             s_valid=s_valid, s_pad=s_pad, qi=qi, ki=ki,
             block_q=block_q, block_k=block_k)
         # dq_block = ds^T @ k == contract ds_t's BK dim with k's BK dim
@@ -280,7 +295,8 @@ def _flash_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
     def _step():
         do = do_ref[0]
         p_t, ds_t = _scores_t(
-            k_ref[0], q_ref[0], v_ref[0], do, lse_ref[...], dsum_ref[...],
+            k_ref[0], q_ref[0], v_ref[0], do, lse_ref[0, 0:1],
+            dsum_ref[0, 0:1],
             scale=scale, causal=causal, s_valid=s_valid, s_pad=s_pad,
             qi=qi, ki=ki, block_q=block_q, block_k=block_k)
         acc_dv[:] += jax.lax.dot_general(
@@ -307,8 +323,12 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, Dv)
     dof = do.reshape(B * H, S, Dv)
-    lsef = lse.reshape(B * H, S)
-    dsumf = dsum.reshape(B * H, S)
+    # sublane-broadcast the per-row stats (see LSE_SUBLANES note up top);
+    # XLA fuses the broadcast into the feeding computation
+    lsef = jnp.broadcast_to(lse.reshape(B * H, 1, S),
+                            (B * H, LSE_SUBLANES, S))
+    dsumf = jnp.broadcast_to(dsum.reshape(B * H, 1, S),
+                             (B * H, LSE_SUBLANES, S))
 
     row_specs = [
         pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
@@ -319,9 +339,9 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
                      memory_space=pltpu.VMEM),              # v
         pl.BlockSpec((1, block_q, Dv), lambda b, i, j: (b, i, 0),
                      memory_space=pltpu.VMEM),              # do
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+        pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, i, j: (b, 0, i),
                      memory_space=pltpu.VMEM),              # lse
-        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i),
+        pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, i, j: (b, 0, i),
                      memory_space=pltpu.VMEM),              # dsum
     ]
     dq = pl.pallas_call(
@@ -346,9 +366,9 @@ def _flash_backward(q, k, v, o, lse, do, *, causal: bool, scale: float,
                      memory_space=pltpu.VMEM),              # v
         pl.BlockSpec((1, block_q, Dv), lambda b, j, i: (b, i, 0),
                      memory_space=pltpu.VMEM),              # do
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+        pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, j, i: (b, 0, i),
                      memory_space=pltpu.VMEM),              # lse
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i),
+        pl.BlockSpec((1, LSE_SUBLANES, block_q), lambda b, j, i: (b, 0, i),
                      memory_space=pltpu.VMEM),              # dsum
     ]
     dk, dv = pl.pallas_call(
@@ -384,8 +404,10 @@ def kernel_supported(dtype_name: str = "bfloat16",
     for this backend's Mosaic?  Model code gates on this (passing the dtype
     and mask mode it will actually run) so a toolchain regression degrades
     to the XLA attention paths instead of killing the training step.  The
-    probe shape fixes D=64/S=128; other head dims share the same Mosaic
-    surface.
+    probe shape fixes B*H=8 / S=256 / D=64: B*H > 1 exercises the
+    batch-blocked (1, ...) specs real Mosaic constrains (a (1,1,S,D) probe
+    green-lit round 2's kernels while every real model shape failed), and
+    S=256 makes the grid multi-block in both q and k.
 
     ``MPI_TF_TPU_DISABLE_FLASH=1`` force-disables the kernels (operator
     kill switch; also the control arm for flash-vs-XLA A/B benches).
@@ -403,7 +425,7 @@ def kernel_supported(dtype_name: str = "bfloat16",
             return False
         if _jax.devices()[0].platform != "tpu":
             return False
-        q = jnp.zeros((1, 1, 128, 64), jnp.dtype(dtype_name))
+        q = jnp.zeros((2, 4, 256, 64), jnp.dtype(dtype_name))
 
         def f(q, k, v):
             return jnp.sum(
