@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Locate the BERT-base train-step stall (VERDICT r2 #1).
+
+Breaks the 84ms step into components by timing ablations on the real chip,
+and quantifies the dispatch/tunnel overhead by sweeping the scan window.
+Each line printed is one JSON record; run AFTER scripts/tpu_measure.sh (the
+chip is single-tenant).
+
+Ablations (all bf16, batch 64, seq 128, adamw):
+  full            — the benchmarked step (flash attn, packed head, dense CE)
+  no_dropout      — train step with dropout 0.0 (isolates threefry+mask cost)
+  xla_attn        — MPI_TF_TPU_DISABLE_FLASH path via use_flash=False
+  fwd_only        — loss forward, no grad/optimizer
+  encoder_only    — encoder forward, no head/loss
+  no_opt          — grads but apply zero update (isolates adamw elementwise)
+"""
+
+from __future__ import annotations
+
+import dataclasses as dc
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mpi_tensorflow_tpu.data import synthetic
+from mpi_tensorflow_tpu.models import bert
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
+from mpi_tensorflow_tpu.train import gspmd
+
+B, S = 64, 128
+
+
+def median_dispatch(fn, *args, iters=10, warmup=2):
+    """Median seconds per dispatch; value-fetch is the sync point."""
+    for _ in range(warmup):
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def make_inputs(K):
+    toks, tgts, mask = synthetic.mlm_batches(K * B, seq_len=S,
+                                             vocab_size=30522, seed=0)
+    shape = (K, B, S)
+    return ({"tokens": jnp.asarray(toks.reshape(shape)),
+             "mask": jnp.asarray(mask.reshape(shape))},
+            jnp.asarray(tgts.reshape(shape)))
+
+
+def build(dropout=0.1, use_flash=True):
+    mesh = meshlib.make_mesh()
+    cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout)
+    model = bert.BertMlm(cfg, mesh=mesh, use_flash=use_flash)
+    tx = optax.adamw(1e-4)
+    state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
+    return model, mesh, tx, state
+
+
+def emit(name, sec_per_step, extra=None):
+    rec = {"ablation": name, "step_ms": round(sec_per_step * 1e3, 3),
+           "tok_per_sec": round(B * S / sec_per_step, 1)}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
+
+
+def main():
+    # 1. scan-window sweep on the full step: separates device step time
+    #    from per-dispatch (tunnel RTT) overhead.  dispatch(K) = K*step + C
+    model, mesh, tx, state0 = build()
+    for K in (1, 4, 16, 32):
+        multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
+        batches, labels = make_inputs(K)
+        sec = median_dispatch(multi, state0, batches, labels,
+                              jax.random.key(1))
+        emit(f"full_scan{K}", sec / K, {"dispatch_ms": round(sec * 1e3, 2),
+                                        "K": K})
+
+    # linear fit: step time and per-dispatch constant
+    # (re-measure K=4 and K=32 for the fit inputs above if noisy)
+
+    # 2. no-dropout ablation
+    model_nd, mesh, tx, state = build(dropout=0.0)
+    multi = gspmd.make_gspmd_multi_step(model_nd, mesh, tx)
+    batches, labels = make_inputs(16)
+    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1))
+    emit("no_dropout_scan16", sec / 16)
+
+    # 3. XLA attention ablation
+    model_x, mesh, tx, state = build(use_flash=False)
+    multi = gspmd.make_gspmd_multi_step(model_x, mesh, tx)
+    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1))
+    emit("xla_attn_scan16", sec / 16)
+
+    # 4. forward-only loss (scan to amortize)
+    model, mesh, tx, state = build()
+
+    @jax.jit
+    def fwd_multi(params, batches, labels, rng):
+        def body(c, xs):
+            b, l = xs
+            loss, _ = model.loss(params, None, b, l, rng=rng, train=True)
+            return c + loss, None
+        return jax.lax.scan(body, jnp.zeros(()), (batches, labels))[0]
+
+    sec = median_dispatch(fwd_multi, state.params, batches, labels,
+                          jax.random.key(1))
+    emit("fwd_only_scan16", sec / 16)
+
+    # 5. encoder-only forward
+    @jax.jit
+    def enc_multi(params, batches, rng):
+        def body(c, b):
+            h = model.encode(params, b["tokens"], train=True, rng=rng)
+            return c + jnp.sum(h.astype(jnp.float32)), None
+        return jax.lax.scan(body, jnp.zeros(()), batches)[0]
+
+    sec = median_dispatch(enc_multi, state.params, batches, jax.random.key(1))
+    emit("encoder_fwd_only_scan16", sec / 16)
+
+    # 6. grads but no optimizer update (isolate adamw elementwise+state IO)
+    @jax.jit
+    def grad_multi(state, batches, labels, rng):
+        def body(s, xs):
+            b, l = xs
+            def lf(p):
+                return model.loss(p, None, b, l, rng=rng, train=True)[0]
+            loss, g = jax.value_and_grad(lf)(s.params)
+            # consume grads without optimizer state IO
+            gsum = sum(jnp.sum(x.astype(jnp.float32)) for x in
+                       jax.tree.leaves(g))
+            return s, loss + 0.0 * gsum
+        return jax.lax.scan(body, state, (batches, labels))[1]
+
+    sec = median_dispatch(grad_multi, state0, batches, labels,
+                          jax.random.key(1))
+    emit("fwd_bwd_no_opt_scan16", sec / 16)
+
+    # 7. XLA's own cost model for one full step
+    one = gspmd.make_gspmd_train_step(model, mesh, tx)
+    b1 = jax.tree.map(lambda x: x[0], make_inputs(1)[0])
+    l1 = make_inputs(1)[1][0]
+    ca = one.lower(state0, b1, l1, jax.random.key(1)).compile() \
+            .cost_analysis()
+    print(json.dumps({"cost_analysis": {
+        "flops": ca.get("flops"),
+        "bytes_accessed": ca.get("bytes accessed"),
+        "opt_seconds": ca.get("optimal_seconds"),
+    }}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Capture a device trace of the BERT train step and print the op-level
+time breakdown (xprof framework_op_stats), grouped by op category.
+
+Answers "where do the milliseconds go" directly — the diagnosis
+scripts/bert_diagnose.py locates the stall by ablation; this names it.
+"""
+
+from __future__ import annotations
+
+import dataclasses as dc
+import glob
+import json
+import os
+import sys
+import tempfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from mpi_tensorflow_tpu.data import synthetic
+from mpi_tensorflow_tpu.models import bert
+from mpi_tensorflow_tpu.parallel import mesh as meshlib
+from mpi_tensorflow_tpu.train import gspmd
+
+B, S, K = 64, 128, 8
+
+
+def main():
+    dropout = float(os.environ.get("PROF_DROPOUT", "0.1"))
+    use_flash = os.environ.get("PROF_FLASH", "1") == "1"
+    mesh = meshlib.make_mesh()
+    cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout)
+    model = bert.BertMlm(cfg, mesh=mesh, use_flash=use_flash)
+    tx = optax.adamw(1e-4)
+    state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
+    multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
+    toks, tgts, mask = synthetic.mlm_batches(K * B, seq_len=S,
+                                             vocab_size=30522, seed=0)
+    shape = (K, B, S)
+    batches = {"tokens": jnp.asarray(toks.reshape(shape)),
+               "mask": jnp.asarray(mask.reshape(shape))}
+    labels = jnp.asarray(tgts.reshape(shape))
+
+    # warmup/compile
+    st, m = multi(state, batches, labels, jax.random.key(1))
+    float(m["loss"][-1])
+
+    logdir = tempfile.mkdtemp(prefix="bertprof_")
+    jax.profiler.start_trace(logdir)
+    st, m = multi(st, batches, labels, jax.random.key(1))
+    float(m["loss"][-1])
+    jax.profiler.stop_trace()
+
+    xplanes = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                        recursive=True)
+    if not xplanes:
+        print(json.dumps({"error": "no xplane captured", "dir": logdir}))
+        return 1
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data(xplanes, "framework_op_stats",
+                                      {"tqx": "out:csv;"})
+    if isinstance(data, bytes):
+        data = data.decode()
+    out = os.environ.get("PROF_CSV", "/tmp/bert_op_stats.csv")
+    with open(out, "w") as f:
+        f.write(data)
+    import csv
+    from collections import defaultdict
+
+    rows = list(csv.DictReader(data.splitlines()))
+    by_cat = defaultdict(float)
+    total = 0.0
+    key_time = None
+    key_cat = None
+    for r0 in rows:
+        for k in r0:
+            lk = k.lower()
+            if key_time is None and "total_self_time" in lk and "us" in lk:
+                key_time = k
+            if key_cat is None and lk in ("category", "op type", "type"):
+                key_cat = k
+        break
+    for r0 in rows:
+        if (r0.get("host_or_device") or r0.get("Host/device", "")
+                ).lower() == "host":
+            continue
+        try:
+            t = float(r0.get(key_time) or 0)
+        except (TypeError, ValueError):
+            continue
+        by_cat[r0.get(key_cat, "?")] += t
+        total += t
+    print(json.dumps({"columns": list(rows[0].keys()) if rows else [],
+                      "csv": out, "trace_dir": logdir}))
+    for cat, t in sorted(by_cat.items(), key=lambda kv: -kv[1]):
+        print(f"{t/1e3/K:9.3f} ms/step  {100*t/total:5.1f}%  {cat}")
+    print(f"{total/1e3/K:9.3f} ms/step  device total (K={K} steps)")
+    # top individual ops
+    rows.sort(key=lambda r0: -(float(r0.get(key_time) or 0)
+                               if (r0.get(key_time) or "").replace(
+                                   ".", "", 1).replace("e", "", 1)
+                               .replace("-", "").isdigit() else 0))
+    print("\ntop 25 ops by self time:")
+    for r0 in rows[:25]:
+        if (r0.get("host_or_device") or "").lower() == "host":
+            continue
+        t = float(r0.get(key_time) or 0)
+        name = (r0.get("operation") or r0.get("Operation")
+                or r0.get("op_name") or "?")
+        print(f"{t/1e3/K:9.3f} ms/step  {r0.get(key_cat, '?')}: {name[:110]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
