@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Round-3 measurement queue in a single process.
+
+Each bench.py invocation pays one full tunneled PJRT client init (~30-60s)
+— with tunnel windows observed at ~16 minutes, per-invocation init burns
+most of the window.  This driver runs the WHOLE queue on one client:
+
+- every result appends one JSON line to MEASURE_LOG.jsonl immediately
+  (a tunnel drop mid-queue loses only the in-flight item);
+- completed items stamp .tpu_done/<name> and are skipped on re-run, so
+  scripts/tpu_watch.sh can fire this repeatedly across windows;
+- items are ordered by information value: the stall diagnosis first,
+  then the ResNet target sweep, then family coverage.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import traceback
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(REPO, ".jax_cache"))
+LOG = os.path.join(REPO, "MEASURE_LOG.jsonl")
+STAMPS = os.path.join(REPO, ".tpu_done")
+
+
+def emit(obj):
+    line = json.dumps(obj)
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def run_item(name, fn):
+    if os.path.exists(os.path.join(STAMPS, name)):
+        return
+    t0 = time.time()
+    try:
+        detail = fn()
+    except Exception as e:  # keep the queue moving; record the failure
+        emit({"item": name, "error": f"{type(e).__name__}: {e}",
+              "traceback": traceback.format_exc()[-600:],
+              "wall_s": round(time.time() - t0, 1)})
+        return
+    emit({"item": name, "wall_s": round(time.time() - t0, 1),
+          "detail": detail})
+    open(os.path.join(STAMPS, name), "w").close()
+
+
+ITEMS = ["bert_diagnose", "bert_profile", "resnet50_b32",
+         "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
+         "gpt_base", "decode", "mnist", "resnet20", "allreduce",
+         "bert_noflash"]
+
+
+def main():
+    os.makedirs(STAMPS, exist_ok=True)
+    if "--check-done" in sys.argv:
+        done = all(os.path.exists(os.path.join(STAMPS, n)) for n in ITEMS)
+        sys.exit(0 if done else 1)
+    os.chdir(REPO)
+    import bench
+
+    # -- 1. stall diagnosis: ablations share the client; each is scan=16
+    def diag():
+        r = subprocess.run([sys.executable, "scripts/bert_diagnose.py"],
+                           capture_output=True, text=True, timeout=1500)
+        return {"stdout": r.stdout[-4000:], "stderr": r.stderr[-1000:],
+                "rc": r.returncode}
+
+    # the diagnose/profile scripts import-and-init their own client; they
+    # still run as subprocesses (their cost_analysis/profiler state should
+    # not leak into the bench numbers) but FIRST in the window
+    run_item("bert_diagnose", diag)
+
+    def prof():
+        r = subprocess.run([sys.executable, "scripts/bert_profile.py"],
+                           capture_output=True, text=True, timeout=1500)
+        return {"stdout": r.stdout[-6000:], "stderr": r.stderr[-1000:],
+                "rc": r.returncode}
+
+    run_item("bert_profile", prof)
+
+    # -- 2. in-process queue: one client init for everything below
+    run_item("resnet50_b32", lambda: bench.measure(
+        batch_size=32, steps=48, precision="bf16", scan_steps=8,
+        model_name="resnet50"))
+    run_item("resnet50_b128_remat", lambda: bench.measure(
+        batch_size=128, steps=48, precision="bf16", scan_steps=8,
+        model_name="resnet50", remat=True))
+    run_item("resnet50_b256_remat", lambda: bench.measure(
+        batch_size=256, steps=48, precision="bf16", scan_steps=8,
+        model_name="resnet50", remat=True))
+    run_item("moe_bert", lambda: bench.measure_bert(
+        batch_size=64, steps=32, precision="bf16", scan_steps=4,
+        model_name="moe_bert"))
+    run_item("gpt_base", lambda: bench.measure_bert(
+        batch_size=64, steps=32, precision="bf16", scan_steps=4,
+        model_name="gpt_base"))
+    run_item("decode", lambda: bench.measure_decode(precision="bf16"))
+    run_item("mnist", lambda: bench.measure(
+        batch_size=64, steps=4000, precision="fp32", scan_steps=400,
+        model_name="mnist_cnn"))
+    run_item("resnet20", lambda: bench.measure(
+        batch_size=128, steps=500, precision="fp32", scan_steps=50,
+        model_name="resnet20"))
+    run_item("allreduce", lambda: bench.measure_allreduce(iters=50))
+
+    # -- 3. the flash-vs-XLA control arm (env-var controlled, needs its own
+    #    process: the disable flag is read at trace time but engagement
+    #    state and jit caches would alias)
+    def noflash():
+        env = dict(os.environ, MPI_TF_TPU_DISABLE_FLASH="1")
+        r = subprocess.run(
+            [sys.executable, "bench.py", "--model", "bert_base",
+             "--precision", "bf16"], capture_output=True, text=True,
+            timeout=1200, env=env)
+        return {"stdout": r.stdout[-2000:], "rc": r.returncode}
+
+    run_item("bert_noflash", noflash)
+    print("queue complete", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,60 +1,29 @@
 #!/usr/bin/env bash
-# Tunnel watcher: probe the axon backend on a cadence; each time it is up,
-# run the next pending measurement from the round-3 queue.  One measurement
-# per probe cycle so a mid-queue tunnel drop loses at most one run.
-# Queue state: each completed step touches a stamp in .tpu_done/.
+# Tunnel watcher: probe the axon backend on a cadence; when it is up, fire
+# the consolidated round-3 queue (scripts/tpu_round3.py — ONE client init
+# for the whole queue, per-item stamps in .tpu_done/, every result
+# appended to MEASURE_LOG.jsonl as it lands).  Exits when the queue is
+# complete.
 set -u
 cd "$(dirname "$0")/.."
 LOG=MEASURE_LOG.jsonl
-STAMPS=.tpu_done
-mkdir -p "$STAMPS"
 export JAX_COMPILATION_CACHE_DIR="$PWD/.jax_cache"
 
 probe() {
   timeout 150 python -c "import jax; jax.devices()" >/dev/null 2>&1
 }
 
-# name|command  (name doubles as the stamp file)
-QUEUE=(
-  "bert_diagnose|python scripts/bert_diagnose.py"
-  "bert_profile|python scripts/bert_profile.py"
-  "resnet50_b32|python bench.py --model resnet50 --precision bf16"
-  "resnet50_b128_remat|python bench.py --model resnet50 --precision bf16 --batch-size 128 --remat"
-  "resnet50_b256_remat|python bench.py --model resnet50 --precision bf16 --batch-size 256 --remat"
-  "moe_bert|python bench.py --model moe_bert --precision bf16"
-  "gpt_base|python bench.py --model gpt_base --precision bf16"
-  "decode|python bench.py --mode decode --precision bf16"
-  "bert_noflash|env MPI_TF_TPU_DISABLE_FLASH=1 python bench.py --model bert_base --precision bf16"
-  "mnist|python bench.py"
-  "resnet20|python bench.py --model resnet20"
-  "allreduce|python bench.py --mode allreduce"
-)
-
 while :; do
-  pending=0
-  for item in "${QUEUE[@]}"; do
-    name="${item%%|*}"; cmd="${item#*|}"
-    [ -e "$STAMPS/$name" ] && continue
-    pending=1
-    if probe; then
-      echo "### watch:$name  $cmd  $(date -u +%FT%TZ)" >> "$LOG"
-      if timeout 1200 bash -c "$cmd" > "$STAMPS/$name.out" 2> "$STAMPS/$name.err"; then
-        tail -40 "$STAMPS/$name.out" >> "$LOG"
-        # an error JSON line (backend died mid-run) does not count as done
-        if tail -1 "$STAMPS/$name.out" | grep -q '"unit": "error"'; then
-          echo "### watch:$name produced error line; will retry $(date -u +%FT%TZ)" >> "$LOG"
-        else
-          touch "$STAMPS/$name"
-        fi
-      else
-        echo "### watch:$name rc=$? (timeout/crash); will retry $(date -u +%FT%TZ)" >> "$LOG"
-        tail -5 "$STAMPS/$name.err" >> "$LOG"
-      fi
-    else
-      echo "### watch: tunnel down $(date -u +%FT%TZ)" >> "$LOG"
-      sleep 300
-    fi
-    break   # re-scan queue from the top after every attempt
-  done
-  [ "$pending" = 0 ] && { echo "### watch: queue complete $(date -u +%FT%TZ)" >> "$LOG"; break; }
+  if python scripts/tpu_round3.py --check-done 2>/dev/null; then
+    echo "### watch: queue complete $(date -u +%FT%TZ)" >> "$LOG"
+    break
+  fi
+  if probe; then
+    echo "### watch: tunnel UP, firing queue $(date -u +%FT%TZ)" >> "$LOG"
+    timeout 7200 python scripts/tpu_round3.py >> /tmp/tpu_round3.out 2>&1
+    echo "### watch: queue run ended rc=$? $(date -u +%FT%TZ)" >> "$LOG"
+  else
+    echo "### watch: tunnel down $(date -u +%FT%TZ)" >> "$LOG"
+    sleep 240
+  fi
 done
