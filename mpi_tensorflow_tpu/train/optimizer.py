@@ -120,9 +120,14 @@ def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
 def transformer_tx(base_lr: float, num_steps: int, *,
                    schedule: str = "warmup_linear",
                    warmup_fraction: float = 0.1,
-                   weight_decay: float = 0.01) -> optax.GradientTransformation:
+                   weight_decay: float = 0.01,
+                   grad_clip_norm: float = 1.0) -> optax.GradientTransformation:
     """adamw under the named schedule — the default for the BERT/GPT loops
-    (constant LR remains available as ``schedule="constant"``)."""
+    (constant LR remains available as ``schedule="constant"``).
+
+    ``grad_clip_norm``: global-norm gradient clipping applied before the
+    adamw update (the canonical BERT/GPT recipe clips at 1.0 — it is what
+    lets warmup survive the early loss-spike regime); 0 disables."""
     warmup = max(1, int(warmup_fraction * num_steps))
     if schedule == "constant":
         lr = base_lr
@@ -132,4 +137,7 @@ def transformer_tx(base_lr: float, num_steps: int, *,
         lr = warmup_cosine(base_lr, warmup, num_steps)
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
-    return optax.adamw(lr, weight_decay=weight_decay)
+    adamw = optax.adamw(lr, weight_decay=weight_decay)
+    if grad_clip_norm and grad_clip_norm > 0:
+        return optax.chain(optax.clip_by_global_norm(grad_clip_norm), adamw)
+    return adamw

@@ -170,3 +170,27 @@ class TestOptimizer:
             assert isinstance(tx, optax.GradientTransformation)
         with pytest.raises(ValueError, match="unknown schedule"):
             optimizer.transformer_tx(1e-3, 100, schedule="nope")
+
+    def test_transformer_tx_clips_global_norm(self):
+        import jax
+        import jax.numpy as jnp
+
+        params = {"w": jnp.zeros((3,))}
+        big = {"w": jnp.array([300.0, 400.0, 0.0])}   # norm 500
+        tx = optimizer.transformer_tx(1.0, 10, schedule="constant",
+                                      weight_decay=0.0, grad_clip_norm=1.0)
+        st = tx.init(params)
+        upd, _ = tx.update(big, st, params)
+        # post-clip grad has norm 1; adam normalizes per-element signs, so
+        # verify via the clip stage alone: direction preserved, magnitude 1
+        import optax
+
+        clip = optax.clip_by_global_norm(1.0)
+        cg, _ = clip.update(big, clip.init(params), params)
+        assert float(jnp.linalg.norm(cg["w"])) == pytest.approx(1.0)
+        assert float(cg["w"][0] / cg["w"][1]) == pytest.approx(0.75)
+        # disabled: identity
+        tx0 = optimizer.transformer_tx(1.0, 10, schedule="constant",
+                                       grad_clip_norm=0.0)
+        assert isinstance(tx0, __import__("optax").GradientTransformation)
+        del jax, upd
