@@ -69,6 +69,11 @@ class BertConfig:
                                   # max_predictions_per_seq, TPU-shaped);
                                   # "all": head over every position
     ce_capacity_frac: float = 0.25  # per-row packed-buffer width / S
+    fused_qkv: bool = False       # compute q,k,v via ONE (E, 3HD) matmul
+                                  # on stacked weights instead of three
+                                  # (E, HD) matmuls — fewer, larger MXU
+                                  # dispatches; parameters stay separate
+                                  # (checkpoints/sharding rules unchanged)
 
     @property
     def head_dim(self) -> int:
@@ -114,8 +119,19 @@ def _layernorm(x, p, eps=1e-12):
 # path (gpt.forward_with_cache): a change to the block cannot silently
 # diverge one of them.
 
-def qkv_proj(lp, h, dt):
-    """(B, S, E) -> per-head q, k, v, each (B, H, S, D)."""
+def qkv_proj(lp, h, dt, fused: bool = False):
+    """(B, S, E) -> per-head q, k, v, each (B, H, S, D).
+
+    ``fused``: stack the three weights at trace time and run one
+    (E, 3HD) matmul — one MXU dispatch instead of three.  The stack is a
+    3.5 MB bf16 copy per layer that XLA typically folds into the matmul
+    operand layout; parameters remain separate leaves either way."""
+    if fused:
+        w = jnp.stack([lp["wq"], lp["wk"], lp["wv"]]).astype(dt)
+        b = jnp.stack([lp["bq"], lp["bk"], lp["bv"]]).astype(dt)
+        qkv = jnp.einsum("bse,cehd->cbhsd", h, w) \
+            + b[:, None, :, None, :]
+        return qkv[0], qkv[1], qkv[2]
     q = jnp.einsum("bse,ehd->bhsd", h, lp["wq"].astype(dt)) \
         + lp["bq"].astype(dt)[None, :, None, :]
     k = jnp.einsum("bse,ehd->bhsd", h, lp["wk"].astype(dt)) \
@@ -335,7 +351,7 @@ class BertMlm:
 
         def layer(h, lp, keys, mlp_fn):
             # --- attention (column-parallel QKV, row-parallel out) ---
-            q, k, v = qkv_proj(lp, h, dt)
+            q, k, v = qkv_proj(lp, h, dt, fused=c.fused_qkv)
             q = self._constrain(q, ("batch", "heads", "seq", "head_dim"))
             k = self._constrain(k, ("batch", "heads", "seq", "head_dim"))
             v = self._constrain(v, ("batch", "heads", "seq", "head_dim"))

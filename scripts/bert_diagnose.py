@@ -34,16 +34,25 @@ from mpi_tensorflow_tpu.train import gspmd
 B, S = 64, 128
 
 
-def median_dispatch(fn, *args, iters=10, warmup=2):
-    """Median seconds per dispatch; value-fetch is the sync point."""
-    for _ in range(warmup):
+def median_dispatch(fn, *args, iters=10, warmup=2, thread_state=False):
+    """Median seconds per dispatch; value-fetch is the sync point.
+
+    ``thread_state``: the first positional arg is a donated train state and
+    ``fn`` returns ``(new_state, aux)`` — each call must consume the
+    PREVIOUS call's output state (the donated input buffers are dead)."""
+    def call(args):
         out = fn(*args)
-        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        np.asarray(jax.tree.leaves(out)[-1]).ravel()[:1]   # sync fetch
+        if thread_state:
+            return (out[0],) + tuple(args[1:])
+        return args
+
+    for _ in range(warmup):
+        args = call(args)
     ts = []
     for _ in range(iters):
         t0 = time.perf_counter()
-        out = fn(*args)
-        np.asarray(jax.tree.leaves(out)[0]).ravel()[:1]
+        args = call(args)
         ts.append(time.perf_counter() - t0)
     return sorted(ts)[len(ts) // 2]
 
@@ -57,9 +66,10 @@ def make_inputs(K):
             jnp.asarray(tgts.reshape(shape)))
 
 
-def build(dropout=0.1, use_flash=True):
+def build(dropout=0.1, use_flash=True, fused_qkv=False):
     mesh = meshlib.make_mesh()
-    cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout)
+    cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout,
+                     fused_qkv=fused_qkv)
     model = bert.BertMlm(cfg, mesh=mesh, use_flash=use_flash)
     tx = optax.adamw(1e-4)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
@@ -78,11 +88,18 @@ def main():
     # 1. scan-window sweep on the full step: separates device step time
     #    from per-dispatch (tunnel RTT) overhead.  dispatch(K) = K*step + C
     model, mesh, tx, state0 = build()
+
+    def fresh():
+        """Deep on-device copy — donated timings consume the copy, the
+        pristine state stays alive for later ablations."""
+        return jax.tree.map(lambda x: x + 0 if hasattr(x, "dtype") else x,
+                            state0)
+
+    multi0 = gspmd.make_gspmd_multi_step(model, mesh, tx)
     for K in (1, 4, 16, 32):
-        multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
         batches, labels = make_inputs(K)
-        sec = median_dispatch(multi, state0, batches, labels,
-                              jax.random.key(1))
+        sec = median_dispatch(multi0, fresh(), batches, labels,
+                              jax.random.key(1), thread_state=True)
         emit(f"full_scan{K}", sec / K, {"dispatch_ms": round(sec * 1e3, 2),
                                         "K": K})
 
@@ -93,17 +110,37 @@ def main():
     model_nd, mesh, tx, state = build(dropout=0.0)
     multi = gspmd.make_gspmd_multi_step(model_nd, mesh, tx)
     batches, labels = make_inputs(16)
-    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1))
+    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1),
+                          thread_state=True)
     emit("no_dropout_scan16", sec / 16)
 
     # 3. XLA attention ablation
     model_x, mesh, tx, state = build(use_flash=False)
     multi = gspmd.make_gspmd_multi_step(model_x, mesh, tx)
-    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1))
+    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1),
+                          thread_state=True)
     emit("xla_attn_scan16", sec / 16)
 
-    # 4. forward-only loss (scan to amortize)
-    model, mesh, tx, state = build()
+    # 3b. fused-QKV candidate (one (E,3HD) matmul per layer)
+    model_fq, mesh, tx, state = build(fused_qkv=True)
+    multi = gspmd.make_gspmd_multi_step(model_fq, mesh, tx)
+    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1),
+                          thread_state=True)
+    emit("fused_qkv_scan16", sec / 16)
+
+    # 3c. rbg PRNG candidate (cheaper dropout mask generation than
+    # threefry) — the key's impl propagates through fold_in/bernoulli
+    rbg_key = jax.random.key(1, impl="rbg")
+    try:
+        sec = median_dispatch(multi0, fresh(), batches, labels, rbg_key,
+                              thread_state=True)
+        emit("rbg_prng_scan16", sec / 16)
+    except Exception as e:
+        print(json.dumps({"ablation": "rbg_prng_scan16",
+                          "error": str(e)[:200]}), flush=True)
+
+    # 4. forward-only loss (scan to amortize) — pristine state0 params
+    params0 = state0.params
 
     @jax.jit
     def fwd_multi(params, batches, labels, rng):
@@ -113,7 +150,7 @@ def main():
             return c + loss, None
         return jax.lax.scan(body, jnp.zeros(()), (batches, labels))[0]
 
-    sec = median_dispatch(fwd_multi, state.params, batches, labels,
+    sec = median_dispatch(fwd_multi, params0, batches, labels,
                           jax.random.key(1))
     emit("fwd_only_scan16", sec / 16)
 
@@ -125,7 +162,7 @@ def main():
             return c + jnp.sum(h.astype(jnp.float32)), None
         return jax.lax.scan(body, jnp.zeros(()), batches)[0]
 
-    sec = median_dispatch(enc_multi, state.params, batches, jax.random.key(1))
+    sec = median_dispatch(enc_multi, params0, batches, jax.random.key(1))
     emit("encoder_fwd_only_scan16", sec / 16)
 
     # 6. grads but no optimizer update (isolate adamw elementwise+state IO)

@@ -59,59 +59,56 @@ def main():
     if not xplanes:
         print(json.dumps({"error": "no xplane captured", "dir": logdir}))
         return 1
-    from xprof.convert import raw_to_tool_data as rtd
-
-    data, _ = rtd.xspace_to_tool_data(xplanes, "framework_op_stats",
-                                      {"tqx": "out:csv;"})
-    if isinstance(data, bytes):
-        data = data.decode()
-    out = os.environ.get("PROF_CSV", "/tmp/bert_op_stats.csv")
-    with open(out, "w") as f:
-        f.write(data)
-    import csv
     from collections import defaultdict
 
-    rows = list(csv.DictReader(data.splitlines()))
+    from xprof.convert import raw_to_tool_data as rtd
+
+    data, _ = rtd.xspace_to_tool_data(xplanes, "framework_op_stats", {})
+    if isinstance(data, bytes):
+        data = data.decode()
+    out = os.environ.get("PROF_JSON", "/tmp/bert_op_stats.json")
+    with open(out, "w") as f:
+        f.write(data)
+    # gviz-JSON: a list of table objects {cols: [{id,...}], rows: [{c:
+    # [{v}, ...]}]} — typically [combined/device table, host table]
+    tables = json.loads(data)
+    if isinstance(tables, dict):
+        tables = [tables]
+    rows = []
+    for tbl in tables:
+        ids = [c["id"] for c in tbl.get("cols", [])]
+        for r0 in tbl.get("rows", []):
+            vals = [cell.get("v") if isinstance(cell, dict) else cell
+                    for cell in r0.get("c", [])]
+            rows.append(dict(zip(ids, vals)))
+    dev = [r0 for r0 in rows
+           if str(r0.get("host_or_device", "")).lower() == "device"]
     by_cat = defaultdict(float)
     total = 0.0
-    key_time = None
-    key_cat = None
-    for r0 in rows:
-        for k in r0:
-            lk = k.lower()
-            if key_time is None and "total_self_time" in lk and "us" in lk:
-                key_time = k
-            if key_cat is None and lk in ("category", "op type", "type"):
-                key_cat = k
-        break
-    for r0 in rows:
-        if (r0.get("host_or_device") or r0.get("Host/device", "")
-                ).lower() == "host":
-            continue
-        try:
-            t = float(r0.get(key_time) or 0)
-        except (TypeError, ValueError):
-            continue
-        by_cat[r0.get(key_cat, "?")] += t
+    def self_us(r0):
+        # observed artifact exports 'total_self_time'; other xprof builds
+        # use 'total_self_time_in_us' — accept either
+        return float(r0.get("total_self_time",
+                            r0.get("total_self_time_in_us")) or 0)
+
+    for r0 in dev:
+        t = self_us(r0)
+        by_cat[str(r0.get("type", "?"))] += t
         total += t
-    print(json.dumps({"columns": list(rows[0].keys()) if rows else [],
-                      "csv": out, "trace_dir": logdir}))
+    print(json.dumps({"json": out, "trace_dir": logdir,
+                      "n_device_rows": len(dev)}))
     for cat, t in sorted(by_cat.items(), key=lambda kv: -kv[1]):
-        print(f"{t/1e3/K:9.3f} ms/step  {100*t/total:5.1f}%  {cat}")
+        print(f"{t/1e3/K:9.3f} ms/step  {100*t/max(total,1e-9):5.1f}%  {cat}")
     print(f"{total/1e3/K:9.3f} ms/step  device total (K={K} steps)")
-    # top individual ops
-    rows.sort(key=lambda r0: -(float(r0.get(key_time) or 0)
-                               if (r0.get(key_time) or "").replace(
-                                   ".", "", 1).replace("e", "", 1)
-                               .replace("-", "").isdigit() else 0))
-    print("\ntop 25 ops by self time:")
-    for r0 in rows[:25]:
-        if (r0.get("host_or_device") or "").lower() == "host":
-            continue
-        t = float(r0.get(key_time) or 0)
-        name = (r0.get("operation") or r0.get("Operation")
-                or r0.get("op_name") or "?")
-        print(f"{t/1e3/K:9.3f} ms/step  {r0.get(key_cat, '?')}: {name[:110]}")
+    dev.sort(key=lambda r0: -self_us(r0))
+    print("\ntop 25 device ops by self time "
+          "(ms/step | %dev | bound_by | op):")
+    for r0 in dev[:25]:
+        t = self_us(r0)
+        print(f"{t/1e3/K:9.3f}  {float(r0.get('device_total_self_time_percent') or 0):5.1f}%"
+              f"  {str(r0.get('bound_by', '?')):10s}"
+              f"  {str(r0.get('type', '?'))}: "
+              f"{str(r0.get('operation', '?'))[:100]}")
     return 0
 
 
