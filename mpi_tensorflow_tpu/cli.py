@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "default: all devices on one data axis")
     p.add_argument("--profile-dir", default=None,
                    help="write a jax.profiler trace here")
+    p.add_argument("--metrics-dir", default=None,
+                   help="stream scalar metrics here: TensorBoard event "
+                        "files (when tensorboardX is available) plus a "
+                        "metrics.jsonl that needs no reader dependency")
     p.add_argument("--fused-steps", type=int, default=None,
                    help="train steps per device dispatch (lax.scan). "
                         "Default: the --log-every cadence for psum mode "
@@ -126,6 +130,7 @@ def config_from_args(args) -> Config:
         mesh_shape=parse_mesh(args.mesh), text_file=args.text_file,
         vocab_file=args.vocab_file,
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
+        metrics_dir=args.metrics_dir,
         precision=args.precision, grad_accum=args.grad_accum,
         pp_schedule=args.pp_schedule,
         prefetch=args.prefetch, remat=args.remat,

@@ -92,6 +92,10 @@ class Config:
     checkpoint_dir: Optional[str] = None   # None = checkpointing off
     resume: bool = False                   # resume from latest in the dir
 
+    # --- metrics sink (SURVEY.md §5 metrics row; the reference has only
+    #     the stdout trace, mpipy.py:88) ---
+    metrics_dir: Optional[str] = None      # TensorBoard events + JSONL here
+
     # --- precision (TPU-first: bf16 on the MXU, fp32 master params) ---
     precision: str = "fp32"       # "fp32" | "bf16": compute dtype for the
                                   # forward/backward matmuls+convs; parameters,

@@ -86,6 +86,80 @@ def extract_images(path: str, num_images: int | None = None) -> np.ndarray:
     return out
 
 
+# -- native WordPiece encoder (native/wordpiece.cpp) ------------------------
+
+_WP_LIB_PATH = os.path.join(_NATIVE_DIR, "libwordpiece.so")
+_wp_lib: Optional[ctypes.CDLL] = None
+_wp_tried = False
+
+
+def _get_wp_lib() -> Optional[ctypes.CDLL]:
+    global _wp_lib, _wp_tried
+    if _wp_lib is not None or _wp_tried:
+        return _wp_lib
+    _wp_tried = True
+    _build()
+    if not os.path.exists(_WP_LIB_PATH):
+        return None
+    try:
+        lib = ctypes.CDLL(_WP_LIB_PATH)
+    except OSError:
+        return None
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+    lib.wp_create.restype = ctypes.c_void_p
+    lib.wp_encode.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                              ctypes.c_int64, i32p, ctypes.c_int64]
+    lib.wp_encode.restype = ctypes.c_int64
+    lib.wp_destroy.argtypes = [ctypes.c_void_p]
+    lib.wp_destroy.restype = None
+    _wp_lib = lib
+    return _wp_lib
+
+
+class WordPieceNative:
+    """Handle to the C++ greedy longest-match encoder for one vocabulary.
+
+    ASCII-only by contract: the C++ lowercasing/char classes match
+    Python's only on the ASCII subset, so callers must route non-ASCII
+    text to the Python encoder (corpus.WordPieceVocab.encode does).
+    """
+
+    def __init__(self, tokens: list):
+        lib = _get_wp_lib()
+        if lib is None:
+            raise RuntimeError("native wordpiece library unavailable")
+        blob = "\n".join(tokens).encode("utf-8")
+        self._lib = lib
+        self._handle = lib.wp_create(blob, len(blob))
+
+    @staticmethod
+    def available() -> bool:
+        return _get_wp_lib() is not None
+
+    def encode(self, text: bytes) -> np.ndarray:
+        """ids for ASCII ``text``; raises on [UNK]-less no-match (same
+        condition as the Python encoder)."""
+        # every emitted id consumes >= 1 input byte, so len(text) bounds
+        # the output; -1 (buffer too small) is therefore impossible here
+        cap = max(8, len(text))
+        out = np.empty(cap, np.int32)
+        n = self._lib.wp_encode(self._handle, text, len(text), out, cap)
+        if n == -2:
+            raise ValueError(
+                "word has no WordPiece match and the vocab has no "
+                "[UNK] token to fall back to")
+        if n < 0:
+            raise RuntimeError(f"native wordpiece encode failed ({n})")
+        return out[:n].copy()
+
+    def __del__(self):
+        lib = getattr(self, "_lib", None)
+        h = getattr(self, "_handle", None)
+        if lib is not None and h:
+            lib.wp_destroy(h)
+
+
 def extract_labels(path: str, num_labels: int | None = None) -> np.ndarray:
     lib = get_lib()
     if lib is None:

@@ -160,7 +160,8 @@ class PipelinedBertMlm(bert_lib.BertMlm):
 
         reduce = None if tp_axis is None else \
             (lambda x: lax.psum(x, tp_axis))
-        q, k, v = bert_lib.qkv_proj(lp, h, dt)   # local head subset if TP
+        q, k, v = bert_lib.qkv_proj(lp, h, dt,   # local head subset if TP
+                                    fused=self.cfg.fused_qkv)
         a = ring.dense_attention(q, k, v)
         a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
         h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)

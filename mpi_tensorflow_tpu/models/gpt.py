@@ -103,7 +103,7 @@ class CausalLm(bert_lib.BertMlm):
 
         new_cache = []
         for lp, cc in zip(params["layers"], cache):
-            q, k, v = bert_lib.qkv_proj(lp, h, dt)
+            q, k, v = bert_lib.qkv_proj(lp, h, dt, fused=c.fused_qkv)
             ck = lax.dynamic_update_slice(cc["k"], k, (0, 0, offset, 0))
             cv = lax.dynamic_update_slice(cc["v"], v, (0, 0, offset, 0))
             new_cache.append({"k": ck, "v": cv})

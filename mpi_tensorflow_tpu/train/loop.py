@@ -132,6 +132,10 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
     from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
 
     hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
+    from mpi_tensorflow_tpu.utils import metrics_writer
+
+    mw = metrics_writer.for_process(config.metrics_dir,
+                                    meshlib.process_index())
     start_step = 0
     if config.resume:
         state, start_step = hooks.resume(state)
@@ -273,6 +277,7 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         preds = run_eval(state)
         global_err = error_rate(preds, splits.test_labels)
         history.append((t, global_err))
+        mw.scalar("eval/test_error_pct", global_err, t)
         if verbose:
             # one line per shard, the reference's per-rank trace
             for r, e in enumerate(evaluation.shard_error_rates(
@@ -314,8 +319,12 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
             run_steps_fused()
         else:
             run_steps()
+        ips_t = timer.images_per_sec(global_b)
+        if ips_t == ips_t:   # skip the NaN of a run with no timed span
+            mw.scalar("perf/images_per_sec", ips_t, num_steps)
     finally:
         hooks.close()   # every queued checkpoint is on disk before return
+        mw.close()      # flush TB events even on an exceptional exit
     final_err = history[-1][1] if history else float("nan")
     ips = timer.images_per_sec(global_b)
     if verbose:

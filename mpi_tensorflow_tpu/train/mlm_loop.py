@@ -122,6 +122,10 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
 
     hooks = CheckpointHooks(config.checkpoint_dir, verbose=verbose)
+    from mpi_tensorflow_tpu.utils import metrics_writer
+
+    mw = metrics_writer.for_process(config.metrics_dir,
+                                    meshlib.process_index())
     start_step = 0
     if config.resume:
         state, start_step = hooks.resume(state)
@@ -189,6 +193,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
                 pending = 0
                 err = masked_error(state)
                 history.append((t, err))
+                mw.scalar("eval/heldout_error_pct", err, t)
+                mw.scalar("train/loss", float(metrics["loss"]), t)
                 if verbose:
                     logs.step_trace(meshlib.process_index(), t, err)
                 hooks.save_async(state, t)
@@ -196,8 +202,12 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
                     hooks.preempt_save(state, t, already_queued=True)
                     break
                 timer.start()
+        sec_t = timer.mean_step_seconds
+        if sec_t == sec_t and sec_t > 0:
+            mw.scalar("perf/tokens_per_sec", b * seq_len / sec_t, num_steps)
     finally:
         hooks.close()
+        mw.close()      # flush TB events even on an exceptional exit
     final_err = history[-1][1] if history else float("nan")
     sec = timer.mean_step_seconds
     tps = b * seq_len / sec if sec == sec and sec > 0 else float("nan")

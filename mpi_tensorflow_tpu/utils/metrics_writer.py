@@ -1,0 +1,80 @@
+"""Structured metrics sink: TensorBoard event files + JSONL fallback.
+
+The reference's only metrics channel is the 50-step stdout trace
+(``/root/reference/mpipy.py:88``); utils/logging.py reproduces that format.
+This module is the machine-readable counterpart (SURVEY.md §5 metrics row):
+scalars stream to a TensorBoard event file when ``tensorboardX`` is
+importable, and ALWAYS to ``<dir>/metrics.jsonl`` (one ``{"step": t,
+"tag": ..., "value": ...}`` line per scalar) so a zero-dependency consumer
+— or this repo's tests — can read the same stream.
+
+Multi-host: only process 0 writes (the scalars passed in are already
+globally reduced by the loops); other processes construct a writer that
+no-ops, so call sites need no rank guard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Optional
+
+
+class MetricsWriter:
+    """Scalar metrics sink; safe no-op when ``log_dir`` is None/empty."""
+
+    def __init__(self, log_dir: Optional[str], *, enabled: bool = True):
+        self._dir = log_dir
+        self._enabled = bool(log_dir) and enabled
+        self._tb = None
+        self._jsonl = None
+        if not self._enabled:
+            return
+        os.makedirs(log_dir, exist_ok=True)
+        self._jsonl = open(os.path.join(log_dir, "metrics.jsonl"), "a",
+                           buffering=1)
+        try:
+            from tensorboardX import SummaryWriter
+
+            self._tb = SummaryWriter(log_dir)
+        except Exception:
+            self._tb = None   # JSONL alone is the contract
+
+    @property
+    def active(self) -> bool:
+        return self._enabled
+
+    def scalar(self, tag: str, value: float, step: int) -> None:
+        if not self._enabled:
+            return
+        v = float(value)
+        self._jsonl.write(json.dumps(
+            {"step": int(step), "tag": tag, "value": v,
+             "time": round(time.time(), 3)}) + "\n")
+        if self._tb is not None:
+            self._tb.add_scalar(tag, v, int(step))
+
+    def scalars(self, values: dict, step: int) -> None:
+        for tag, v in values.items():
+            self.scalar(tag, v, step)
+
+    def close(self) -> None:
+        self._enabled = False   # scalar() after close() is a silent no-op
+        if self._jsonl is not None:
+            self._jsonl.close()
+            self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def for_process(log_dir: Optional[str], process_index: int) -> MetricsWriter:
+    """Writer that is active on process 0 only (scalars are global)."""
+    return MetricsWriter(log_dir, enabled=process_index == 0)

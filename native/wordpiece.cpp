@@ -1,0 +1,149 @@
+// Native WordPiece batch encoder — the hot half of the real-text data
+// path (data/corpus.py).  Fills the native data-loader role the reference
+// delegates to TF's C++ runtime (SURVEY.md §2 E2); the Python
+// WordPieceVocab.encode remains the reference implementation and the
+// fallback, and tests pin byte-identical ids between the two.
+//
+// Scope contract (mirrors data/corpus.py::WordPieceVocab.encode for the
+// ASCII subset): lowercase, split on whitespace; any char outside
+// [A-Za-z0-9'] is its own single-char word; greedy longest-prefix match
+// with "##" continuation pieces; a word with no full piece cover encodes
+// as [UNK].  Non-ASCII input must take the Python path (Unicode lowering
+// and classification differ) — the binding enforces that gate.
+//
+// Exposed C ABI (ctypes, see data/native.py):
+//   wp_create(tokens_blob, n_tokens)       -> handle (tokens are
+//       '\n'-joined in one buffer; id = position in the list)
+//   wp_encode(handle, text, text_len, out, out_cap) -> n_ids written,
+//       or -1 if out_cap is too small, -2 if a word needs [UNK] but the
+//       vocab has none
+//   wp_destroy(handle)
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <string_view>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Vocab {
+  std::string blob;  // owns all token bytes
+  std::unordered_map<std::string_view, int32_t> id_of;
+  size_t max_piece = 1;
+  int32_t unk = -1;
+};
+
+inline bool is_word_char(unsigned char c) {
+  return (c >= 'a' && c <= 'z') || (c >= '0' && c <= '9') || c == '\'';
+}
+
+// Python str.isspace() over the ASCII range: \t\n\v\f\r, space, AND the
+// C1 separators 0x1C-0x1F — std::isspace misses the latter, which would
+// silently break byte-for-byte parity with the reference encoder.
+inline bool is_space_py(unsigned char c) {
+  return c == ' ' || (c >= 0x09 && c <= 0x0D) || (c >= 0x1C && c <= 0x1F);
+}
+
+inline unsigned char lower(unsigned char c) {
+  return (c >= 'A' && c <= 'Z') ? c + 32 : c;
+}
+
+// Greedy longest-match over one lowercased word; appends ids to out.
+// Returns false when the word has no full cover (caller emits UNK).
+bool match_word(const Vocab& v, std::string_view word,
+                std::vector<int32_t>& out) {
+  size_t start = out.size();
+  std::string cand;
+  size_t pos = 0;
+  while (pos < word.size()) {
+    size_t end = std::min(word.size(), pos + v.max_piece);
+    int32_t piece = -1;
+    for (; end > pos; --end) {
+      cand.clear();
+      if (pos > 0) cand += "##";
+      cand.append(word.substr(pos, end - pos));
+      auto it = v.id_of.find(std::string_view(cand));
+      if (it != v.id_of.end()) {
+        piece = it->second;
+        break;
+      }
+    }
+    if (piece < 0) {
+      out.resize(start);
+      return false;
+    }
+    out.push_back(piece);
+    pos = end;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* wp_create(const char* tokens_blob, int64_t blob_len) {
+  auto* v = new Vocab();
+  v->blob.assign(tokens_blob, static_cast<size_t>(blob_len));
+  int32_t id = 0;
+  size_t start = 0;
+  const std::string& b = v->blob;
+  for (size_t i = 0; i <= b.size(); ++i) {
+    if (i == b.size() || b[i] == '\n') {
+      if (i > start) {
+        std::string_view tok(&b[start], i - start);
+        v->id_of.emplace(tok, id);
+        if (tok.size() > v->max_piece) v->max_piece = tok.size();
+        if (tok == "[UNK]") v->unk = id;
+      }
+      ++id;  // empty lines keep ids aligned with the Python list index
+      start = i + 1;
+    }
+  }
+  return v;
+}
+
+int64_t wp_encode(void* handle, const char* text, int64_t text_len,
+                  int32_t* out, int64_t out_cap) {
+  const Vocab& v = *static_cast<Vocab*>(handle);
+  std::vector<int32_t> ids;
+  ids.reserve(static_cast<size_t>(text_len) / 4 + 8);
+  std::string word;
+  std::string cand;
+
+  auto flush_word = [&](const std::string& w) -> bool {
+    if (w.empty()) return true;
+    if (!match_word(v, w, ids)) {
+      if (v.unk < 0) return false;
+      ids.push_back(v.unk);
+    }
+    return true;
+  };
+
+  for (int64_t i = 0; i < text_len; ++i) {
+    unsigned char c = lower(static_cast<unsigned char>(text[i]));
+    if (is_space_py(c)) {
+      if (!flush_word(word)) return -2;
+      word.clear();
+    } else if (!is_word_char(c)) {
+      if (!flush_word(word)) return -2;
+      word.clear();
+      word.push_back(static_cast<char>(c));  // punctuation: own word
+      if (!flush_word(word)) return -2;
+      word.clear();
+    } else {
+      word.push_back(static_cast<char>(c));
+    }
+  }
+  if (!flush_word(word)) return -2;
+
+  if (static_cast<int64_t>(ids.size()) > out_cap) return -1;
+  std::memcpy(out, ids.data(), ids.size() * sizeof(int32_t));
+  return static_cast<int64_t>(ids.size());
+}
+
+void wp_destroy(void* handle) { delete static_cast<Vocab*>(handle); }
+
+}  // extern "C"

@@ -152,6 +152,76 @@ class TestWordPiece:
             == ["hello", "world"]
 
 
+@pytest.mark.quick
+class TestNativeWordPiece:
+    """The C++ batch encoder (native/wordpiece.cpp) must be bit-identical
+    to the Python reference implementation on its ASCII contract — the
+    same invariant the native IDX loader pins (data/native.py header)."""
+
+    def _pair(self, tokens):
+        """(vocab routed to native, vocab forced onto the Python path)."""
+        from mpi_tensorflow_tpu.data import native
+
+        if not native.WordPieceNative.available():
+            pytest.skip("native toolchain unavailable")
+        nat = corpus.WordPieceVocab(tokens)
+        py = corpus.WordPieceVocab(tokens)
+        py._native_tried = True     # force the reference implementation
+        return nat, py
+
+    def test_parity_on_random_ascii(self):
+        import random
+
+        pieces = ["[PAD]", "[UNK]", "[MASK]", "the", "quick", "brown",
+                  "fox", "jump", "##s", "##ing", "##ed", "over", "lazy",
+                  "dog", "run", "##ner", "a", "b", "##c", "'", ",", ".",
+                  "!", "x", "##yz", "un", "##aff", "##able"]
+        nat, py = self._pair(pieces)
+        rng = random.Random(0)
+        words = ["The", "quick", "BROWN", "fox", "jumps", "jumping",
+                 "unaffable", "zzzz", "runner", "a'bc", "x", "!!", "a,b."]
+        for trial in range(50):
+            text = " ".join(rng.choices(words, k=rng.randrange(0, 40)))
+            got = nat.encode(text)
+            want = py.encode(text)
+            assert got.dtype == want.dtype == __import__("numpy").int32
+            assert got.tolist() == want.tolist(), text
+
+    def test_native_engaged_for_ascii(self):
+        nat, _ = self._pair(["[UNK]", "hi"])
+        nat.encode("hi hi")
+        assert nat._native is not None
+
+    def test_control_char_whitespace_parity(self):
+        # \x1c-\x1f are whitespace to Python str.isspace() but not to C
+        # isspace — the native encoder must match Python exactly
+        nat, py = self._pair(["[UNK]", "a", "b"])
+        for ch in ("\x1c", "\x1d", "\x1e", "\x1f", "\x0b", "\x0c"):
+            text = f"a{ch}b"
+            assert nat.encode(text).tolist() == py.encode(text).tolist(), \
+                repr(ch)
+
+    def test_non_ascii_routes_to_python(self):
+        nat, py = self._pair(["[UNK]", "caf", "##e", "hi"])
+        # é lowers/classifies differently under Unicode — must NOT hit the
+        # C++ path; both vocab objects agree because both use Python here
+        assert nat.encode("café hi").tolist() == py.encode("café hi").tolist()
+
+    def test_unk_less_vocab_raises_both_paths(self):
+        nat, py = self._pair(["hello"])
+        with pytest.raises(ValueError, match="no .UNK."):
+            py.encode("zzz")
+        with pytest.raises(ValueError, match="no .UNK."):
+            nat.encode("zzz")
+
+    def test_long_corpus_parity_at_max_density(self):
+        # single-char vocab makes ids-per-byte ~1 — the tightest case for
+        # the len(text) output-capacity bound in WordPieceNative.encode
+        nat, py = self._pair(["[UNK]", "a", "##a", "b", "##b"])
+        text = "".join(__import__("random").Random(1).choices("ab ", k=5000))
+        assert nat.encode(text).tolist() == py.encode(text).tolist()
+
+
 class TestFlagshipVocab:
     """The perf-critical path gets a real-data consumer: a 30522-entry
     vocabulary through masked packing + tied_softmax_ce (the flagship
