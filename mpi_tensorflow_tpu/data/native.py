@@ -26,29 +26,31 @@ _lib_tried = False
 
 def _build() -> bool:
     try:
-        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+        # -k: targets are independent (idx needs -lz, wordpiece does not);
+        # one target's link failure must not silently disable the others
+        subprocess.run(["make", "-C", _NATIVE_DIR, "-k"], check=False,
                        capture_output=True, timeout=120)
         return os.path.exists(_LIB_PATH)
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
 
 
-def get_lib() -> Optional[ctypes.CDLL]:
-    """The loaded library, building it if needed; None if unavailable."""
-    global _lib, _lib_tried
-    if _lib is not None or _lib_tried:
-        return _lib
-    _lib_tried = True
-    # run make unconditionally (no-op when up to date) so source edits are
-    # never shadowed by a stale binary; a failed build (no make on PATH)
-    # still falls back to a previously built library if one exists
+def _load_native_lib(path: str, configure) -> Optional[ctypes.CDLL]:
+    """Shared lazy loader: run the (no-op-when-fresh) build, dlopen
+    ``path``, apply ``configure(lib)`` to declare the symbol signatures.
+    Returns None when the toolchain or the library is unavailable."""
     _build()
-    if not os.path.exists(_LIB_PATH):
+    if not os.path.exists(path):
         return None
     try:
-        lib = ctypes.CDLL(_LIB_PATH)
+        lib = ctypes.CDLL(path)
     except OSError:
         return None
+    configure(lib)
+    return lib
+
+
+def _configure_idx(lib) -> None:
     u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
     f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
@@ -58,7 +60,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
     lib.idx_load_images.restype = ctypes.c_int
     lib.idx_load_labels.argtypes = [ctypes.c_char_p, ctypes.c_int, i64p]
     lib.idx_load_labels.restype = ctypes.c_int
-    _lib = lib
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded IDX library, building it if needed; None if unavailable."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    _lib_tried = True
+    _lib = _load_native_lib(_LIB_PATH, _configure_idx)
     return _lib
 
 
@@ -93,18 +103,7 @@ _wp_lib: Optional[ctypes.CDLL] = None
 _wp_tried = False
 
 
-def _get_wp_lib() -> Optional[ctypes.CDLL]:
-    global _wp_lib, _wp_tried
-    if _wp_lib is not None or _wp_tried:
-        return _wp_lib
-    _wp_tried = True
-    _build()
-    if not os.path.exists(_WP_LIB_PATH):
-        return None
-    try:
-        lib = ctypes.CDLL(_WP_LIB_PATH)
-    except OSError:
-        return None
+def _configure_wp(lib) -> None:
     i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
     lib.wp_create.argtypes = [ctypes.c_char_p, ctypes.c_int64]
     lib.wp_create.restype = ctypes.c_void_p
@@ -113,7 +112,14 @@ def _get_wp_lib() -> Optional[ctypes.CDLL]:
     lib.wp_encode.restype = ctypes.c_int64
     lib.wp_destroy.argtypes = [ctypes.c_void_p]
     lib.wp_destroy.restype = None
-    _wp_lib = lib
+
+
+def _get_wp_lib() -> Optional[ctypes.CDLL]:
+    global _wp_lib, _wp_tried
+    if _wp_lib is not None or _wp_tried:
+        return _wp_lib
+    _wp_tried = True
+    _wp_lib = _load_native_lib(_WP_LIB_PATH, _configure_wp)
     return _wp_lib
 
 

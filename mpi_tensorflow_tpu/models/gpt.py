@@ -80,10 +80,15 @@ class CausalLm(bert_lib.BertMlm):
         length, offset 0) and single-token decode (S_in = 1, traced
         offset).  Returns (fp32 logits (B, S_in, V), updated cache).
 
-        Decode runs unsharded (single-chip inference path); the sharded
-        batch case works through GSPMD on the batch dim of B.  Math is
-        kept in lockstep with the training stack — pinned by the
-        incremental-vs-full parity test (tests/test_gpt.py)."""
+        Distributed decode: when the model carries a mesh, the same
+        logical-axis constraints as training apply — batch over ``data``,
+        attention heads (and therefore the KV cache's H dim) over
+        ``model`` with GSPMD inserting the row-parallel psum in
+        ``attn_out_proj``; the cache length dim stays replicated
+        (``pos``) so the traced-offset dynamic_update_slice never crosses
+        a shard boundary.  Math is kept in lockstep with the training
+        stack — pinned by the incremental-vs-full parity test and the
+        sharded-vs-single-device decode test (tests/test_gpt.py)."""
         c = self.cfg
         dt = c.dtype
         B, S_in = tokens.shape
@@ -94,6 +99,7 @@ class CausalLm(bert_lib.BertMlm):
             params["pos_emb"], (offset, 0), (S_in, c.hidden))
         h = params["tok_emb"][tokens] + pos_emb[None]
         h = _layernorm(h, params["emb_ln"]).astype(dt)
+        h = self._constrain(h, ("batch", "seq", "embed"))
 
         pos = offset + jnp.arange(S_in)                    # (S_in,) absolute
         col = jnp.arange(L)
@@ -101,11 +107,16 @@ class CausalLm(bert_lib.BertMlm):
         vis = col[None, :] <= pos[:, None]                 # (S_in, L)
         scale = c.head_dim ** -0.5
 
+        qkv_axes = ("batch", "heads", "seq", "head_dim")
+        cache_axes = ("batch", "heads", "pos", "head_dim")
         new_cache = []
         for lp, cc in zip(params["layers"], cache):
             q, k, v = bert_lib.qkv_proj(lp, h, dt, fused=c.fused_qkv)
+            q = self._constrain(q, qkv_axes)
             ck = lax.dynamic_update_slice(cc["k"], k, (0, 0, offset, 0))
             cv = lax.dynamic_update_slice(cc["v"], v, (0, 0, offset, 0))
+            ck = self._constrain(ck, cache_axes)
+            cv = self._constrain(cv, cache_axes)
             new_cache.append({"k": ck, "v": cv})
             s = jnp.einsum("bhsd,bhld->bhsl", q, ck).astype(jnp.float32)
             s = jnp.where(vis[None, None], s * scale,
@@ -114,12 +125,18 @@ class CausalLm(bert_lib.BertMlm):
             a = jnp.einsum("bhsl,bhld->bhsd", p, cv)
             a = bert_lib.attn_out_proj(lp, a, dt)
             h = _layernorm(h + a, lp["ln1"]).astype(dt)
-            m = bert_lib.gelu_mlp(lp, h, dt)
+            h = self._constrain(h, ("batch", "seq", "embed"))
+            m = bert_lib.gelu_mlp(
+                lp, h, dt,
+                constrain=lambda m_: self._constrain(
+                    m_, ("batch", "seq", "mlp")))
             h = _layernorm(h + m, lp["ln2"]).astype(dt)
+            h = self._constrain(h, ("batch", "seq", "embed"))
 
         t = self.head_hidden(params, h)
         logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
             + params["mlm"]["out_b"]
+        logits = self._constrain(logits, ("batch", "seq", "vocab"))
         return logits.astype(jnp.float32), new_cache
 
     def generate(self, params, prompt, max_new_tokens: int, *,

@@ -49,8 +49,11 @@ class MetricsWriter:
         if not self._enabled:
             return
         v = float(value)
+        # NaN/Inf are not JSON; strict consumers (jq, JSON.parse) abort the
+        # whole stream on one bad line — encode them as null instead
+        jv = v if v == v and abs(v) != float("inf") else None
         self._jsonl.write(json.dumps(
-            {"step": int(step), "tag": tag, "value": v,
+            {"step": int(step), "tag": tag, "value": jv,
              "time": round(time.time(), 3)}) + "\n")
         if self._tb is not None:
             self._tb.add_scalar(tag, v, int(step))
