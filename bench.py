@@ -83,7 +83,9 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
     bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype,
-                      ce_impl=ce_impl, ce_chunk=ce_chunk, remat=remat)
+                      ce_impl=ce_impl, ce_chunk=ce_chunk, remat=remat,
+                      max_positions=max(bert.BERT_BASE.max_positions,
+                                        seq_len))
     if model_name == "moe_bert":
         from mpi_tensorflow_tpu.models import moe
 
@@ -419,6 +421,11 @@ def main(argv=None) -> int:
                          "never materializing (B,S,V) fp32 logits")
     ap.add_argument("--ce-chunk", type=int, default=2048,
                     help="vocab tile width for --ce chunked")
+    ap.add_argument("--seq-len", type=int, default=None,
+                    help="sequence length for the transformer families "
+                         "(default per-model, 128).  Long sequences are "
+                         "where the flash attention kernels earn their "
+                         "keep — pair with a smaller --batch-size")
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize residual blocks / encoder layers "
                          "(frees HBM for larger batches)")
@@ -434,6 +441,15 @@ def main(argv=None) -> int:
                          "off on the MXU-bound families (BERT/ResNet-50), "
                          "convergence pinned by tests/test_precision.py.")
     args = ap.parse_args(argv)
+
+    if args.seq_len is not None:
+        if args.mode != "train" or args.model not in (
+                "bert_base", "moe_bert", "gpt_base"):
+            ap.error("--seq-len applies to the transformer families in "
+                     "train mode only (decode uses --prompt-len/"
+                     "--new-tokens)")
+        if args.seq_len < 1:
+            ap.error(f"--seq-len must be >= 1, got {args.seq_len}")
 
     if not _backend_reachable():
         # one parseable line beats an unbounded hang for whoever runs this
@@ -516,7 +532,9 @@ def main(argv=None) -> int:
     if args.model in ("bert_base", "moe_bert", "gpt_base"):
         result = measure_bert(batch_size=batch, steps=steps,
                               precision=args.precision, scan_steps=scan,
-                              seq_len=spec["seq"], ce_impl=args.ce,
+                              seq_len=(args.seq_len if args.seq_len is not None
+                                       else spec["seq"]),
+                              ce_impl=args.ce,
                               ce_chunk=args.ce_chunk, model_name=args.model,
                               remat=args.remat, params_bf16=args.params_bf16)
         label = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",

@@ -55,8 +55,8 @@ def run_item(name, fn):
 
 ITEMS = ["bert_diagnose", "bert_profile", "resnet50_b32",
          "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
-         "gpt_base", "decode", "mnist", "resnet20", "allreduce",
-         "bert_noflash"]
+         "gpt_base", "decode", "bert_s512", "bert_s2048", "mnist",
+         "resnet20", "allreduce", "bert_noflash", "bert_s2048_noflash"]
 
 
 def main():
@@ -104,6 +104,14 @@ def main():
         batch_size=64, steps=32, precision="bf16", scan_steps=4,
         model_name="gpt_base"))
     run_item("decode", lambda: bench.measure_decode(precision="bf16"))
+    # long-context flagship: S=512 and S=2048 — the regime the flash
+    # fwd+bwd kernels target (attention is O(S^2); at S=128 it is noise)
+    run_item("bert_s512", lambda: bench.measure_bert(
+        batch_size=16, steps=16, precision="bf16", scan_steps=4,
+        seq_len=512))
+    run_item("bert_s2048", lambda: bench.measure_bert(
+        batch_size=4, steps=8, precision="bf16", scan_steps=2,
+        seq_len=2048))
     run_item("mnist", lambda: bench.measure(
         batch_size=64, steps=4000, precision="fp32", scan_steps=400,
         model_name="mnist_cnn"))
@@ -115,15 +123,20 @@ def main():
     # -- 3. the flash-vs-XLA control arm (env-var controlled, needs its own
     #    process: the disable flag is read at trace time but engagement
     #    state and jit caches would alias)
-    def noflash():
+    def noflash(extra=()):
         env = dict(os.environ, MPI_TF_TPU_DISABLE_FLASH="1")
         r = subprocess.run(
             [sys.executable, "bench.py", "--model", "bert_base",
-             "--precision", "bf16"], capture_output=True, text=True,
-            timeout=1200, env=env)
-        return {"stdout": r.stdout[-2000:], "rc": r.returncode}
+             "--precision", "bf16", *extra], capture_output=True,
+            text=True, timeout=1200, env=env)
+        return {"stdout": r.stdout[-2000:], "stderr": r.stderr[-800:],
+                "rc": r.returncode}
 
     run_item("bert_noflash", noflash)
+    # the control arm where flash should WIN: long context
+    run_item("bert_s2048_noflash", lambda: noflash(
+        ("--seq-len", "2048", "--batch-size", "4", "--scan-steps", "2",
+         "--steps", "8")))
     print("queue complete", flush=True)
 
 
