@@ -55,9 +55,13 @@ def batch_norm(x, params: dict, state: dict, *, train: bool,
     the train step averages the updated running stats across shards so the
     replicated state stays in sync.
     """
-    # statistics and normalization always run in float32 — under a bfloat16
-    # compute policy the convs feed bf16 activations in, but variance in bf16
-    # loses too many mantissa bits (mixed-precision BN convention)
+    # statistics and the normalization arithmetic run in float32 (variance
+    # in bf16 loses too many mantissa bits — mixed-precision BN
+    # convention), but the OUTPUT returns in the caller's compute dtype:
+    # materializing fp32 activations under a bf16 policy would double the
+    # HBM traffic of every BN in the network (the fp32 math here fuses
+    # into the surrounding kernel; the bf16 store is what hits memory)
+    in_dtype = x.dtype
     x = x.astype(jnp.float32)
     axes = tuple(range(x.ndim - 1))
     if train:
@@ -72,4 +76,4 @@ def batch_norm(x, params: dict, state: dict, *, train: bool,
         new_state = state
     inv = lax.rsqrt(var + eps) * params["scale"]
     y = (x - mean) * inv + params["offset"]
-    return y, new_state
+    return y.astype(in_dtype), new_state

@@ -129,14 +129,21 @@ def main():
             [sys.executable, "bench.py", "--model", "bert_base",
              "--precision", "bf16", *extra], capture_output=True,
             text=True, timeout=1200, env=env)
-        return {"stdout": r.stdout[-2000:], "stderr": r.stderr[-800:],
-                "rc": r.returncode}
+        if r.returncode != 0 or '"unit": "error"' in r.stdout:
+            # raise so run_item does NOT stamp: a tunnel drop here must be
+            # retried next window like the in-process items are
+            raise RuntimeError(
+                f"noflash arm failed rc={r.returncode}: "
+                f"{r.stdout[-300:]} {r.stderr[-300:]}")
+        return {"stdout": r.stdout[-2000:], "rc": r.returncode}
 
     run_item("bert_noflash", noflash)
-    # the control arm where flash should WIN: long context
+    # the control arm where flash should WIN: long context.  --remat keeps
+    # the XLA dense-attention arm inside HBM (12 layers of (4,12,2048,2048)
+    # fp32 scores would otherwise OOM before producing the comparison)
     run_item("bert_s2048_noflash", lambda: noflash(
         ("--seq-len", "2048", "--batch-size", "4", "--scan-steps", "2",
-         "--steps", "8")))
+         "--steps", "8", "--remat")))
     print("queue complete", flush=True)
 
 

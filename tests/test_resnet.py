@@ -46,6 +46,25 @@ class TestResNet:
         logits, _ = r50.apply_with_state(params, state, x, train=False)
         assert logits.shape == (1, 1000)
 
+    def test_batch_norm_keeps_compute_dtype(self):
+        """Mixed-precision BN contract: stats in fp32, output in the
+        caller's dtype — an fp32 output under a bf16 policy would double
+        every BN's activation HBM traffic (the ResNet-50 MFU lever)."""
+        import jax
+        import jax.numpy as jnp
+
+        from mpi_tensorflow_tpu.ops import nn
+
+        for dt in (jnp.bfloat16, jnp.float32):
+            x = jax.random.normal(jax.random.key(0), (4, 8, 8, 16)) \
+                .astype(dt)
+            p = nn.bn_init(16)
+            s = nn.bn_state_init(16)
+            y, ns = nn.batch_norm(x, p, s, train=True)
+            assert y.dtype == dt, (dt, y.dtype)
+            assert ns["mean"].dtype == jnp.float32   # stats stay fp32
+            assert ns["var"].dtype == jnp.float32
+
     def test_l2_params_excludes_bn(self, r20):
         params = r20.init(jax.random.key(0))
         subset = r20.l2_params(params)
