@@ -1,0 +1,75 @@
+"""Round-3 measurement queue driver (scripts/tpu_round3.py): the
+stamp/retry semantics are what let short tunnel windows accumulate into a
+complete measurement set — a failure stamped as done is a measurement
+silently lost (the failure mode the watcher design exists to avoid)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture()
+def queue_mod(tmp_path, monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "tpu_round3", os.path.join(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))),
+            "scripts", "tpu_round3.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "STAMPS", str(tmp_path / "stamps"))
+    monkeypatch.setattr(mod, "LOG", str(tmp_path / "log.jsonl"))
+    os.makedirs(mod.STAMPS, exist_ok=True)
+    return mod
+
+
+class TestRunItem:
+    def test_success_stamps_and_skips(self, queue_mod):
+        calls = []
+        queue_mod.run_item("a", lambda: calls.append(1) or {"v": 1})
+        queue_mod.run_item("a", lambda: calls.append(1) or {"v": 1})
+        assert len(calls) == 1                       # second run skipped
+        assert os.path.exists(os.path.join(queue_mod.STAMPS, "a"))
+
+    def test_failure_does_not_stamp_and_retries(self, queue_mod):
+        state = {"n": 0}
+
+        def flaky():
+            state["n"] += 1
+            if state["n"] == 1:
+                raise RuntimeError("tunnel dropped")
+            return {"ok": True}
+
+        queue_mod.run_item("b", flaky)
+        assert not os.path.exists(os.path.join(queue_mod.STAMPS, "b"))
+        queue_mod.run_item("b", flaky)               # retried next window
+        assert os.path.exists(os.path.join(queue_mod.STAMPS, "b"))
+        recs = [json.loads(line) for line in open(queue_mod.LOG)]
+        assert "error" in recs[0] and "detail" in recs[1]
+
+    def test_error_lines_are_strict_json(self, queue_mod):
+        queue_mod.run_item("c", lambda: (_ for _ in ()).throw(
+            ValueError("boom")))
+        for line in open(queue_mod.LOG):
+            json.loads(line)                          # must not raise
+
+    def test_check_done_semantics(self, queue_mod):
+        for name in queue_mod.ITEMS[:-1]:
+            open(os.path.join(queue_mod.STAMPS, name), "w").close()
+        argv = sys.argv
+        try:
+            sys.argv = ["tpu_round3.py", "--check-done"]
+            with pytest.raises(SystemExit) as e:
+                queue_mod.main()
+            assert e.value.code == 1                 # one item pending
+            open(os.path.join(queue_mod.STAMPS,
+                              queue_mod.ITEMS[-1]), "w").close()
+            with pytest.raises(SystemExit) as e:
+                queue_mod.main()
+            assert e.value.code == 0                 # all stamped
+        finally:
+            sys.argv = argv
