@@ -140,16 +140,35 @@ class CausalLm(bert_lib.BertMlm):
         return logits.astype(jnp.float32), new_cache
 
     def generate(self, params, prompt, max_new_tokens: int, *,
-                 temperature: float = 0.0, rng=None):
+                 temperature: float = 0.0, top_k: int = 0,
+                 top_p: float = 1.0, rng=None,
+                 cache_len: int | None = None):
         """Autoregressive decode: greedy (``temperature == 0``) or
-        temperature sampling.  ``prompt``: (B, S0) int ids.  Returns
+        temperature sampling, optionally filtered by ``top_k`` (keep the k
+        highest-probability tokens) and/or ``top_p`` (nucleus: keep the
+        smallest prefix of the probability-sorted vocab whose mass reaches
+        p).  ``prompt``: (B, S0) int ids.  Returns
         (B, S0 + max_new_tokens) — the prompt with the continuation.
 
         Prefill computes the whole prompt in one batched forward (MXU-
         friendly); the per-token loop is a ``lax.scan`` over a static
-        cache, so the whole call is one ``jit`` compilation."""
+        cache, so the whole call is one ``jit`` compilation.
+
+        ``cache_len`` overrides the KV-cache capacity (default: exactly
+        prompt + new tokens).  Every decode step attends over the full
+        (masked) cache buffer, so per-step cost scales with the CAPACITY,
+        not the occupancy — benchmark arms comparing different generation
+        lengths must pin the same cache_len or the comparison is
+        apples-to-oranges (bench.measure_decode does)."""
         if temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs an rng")
+        if (top_k > 0 or top_p < 1.0) and temperature <= 0.0:
+            raise ValueError(
+                "top_k/top_p filter the sampling distribution; they have "
+                "no effect under greedy decoding (temperature 0) — pass "
+                "temperature > 0")
+        if not 0.0 < top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
         if max_new_tokens < 0:
             raise ValueError(f"max_new_tokens must be >= 0, "
                              f"got {max_new_tokens}")
@@ -157,15 +176,20 @@ class CausalLm(bert_lib.BertMlm):
             return prompt
         B, S0 = prompt.shape
         total = S0 + max_new_tokens
-        cache = self.init_cache(B, total)
+        if cache_len is not None and cache_len < total:
+            raise ValueError(f"cache_len {cache_len} < prompt + "
+                             f"max_new_tokens ({total})")
+        cache = self.init_cache(B, cache_len or total)
         logits, cache = self.forward_with_cache(params, prompt, cache, 0)
-        first = self._sample(logits[:, -1], temperature, rng, 0)
+        first = self._sample(logits[:, -1], temperature, rng, 0,
+                             top_k=top_k, top_p=top_p)
 
         def step(carry, i):
             cache, token, key = carry
             logits, cache = self.forward_with_cache(
                 params, token[:, None], cache, S0 + i)
-            nxt = self._sample(logits[:, 0], temperature, key, i + 1)
+            nxt = self._sample(logits[:, 0], temperature, key, i + 1,
+                               top_k=top_k, top_p=top_p)
             return (cache, nxt, key), token
 
         (_, last, _), toks = lax.scan(
@@ -176,10 +200,35 @@ class CausalLm(bert_lib.BertMlm):
             if max_new_tokens > 1 else first[:, None]
         return jnp.concatenate([prompt, out], axis=1)
 
-    def _sample(self, logits, temperature, rng, i):
-        """(B, V) logits -> (B,) token ids."""
+    def _sample(self, logits, temperature, rng, i, *, top_k: int = 0,
+                top_p: float = 1.0):
+        """(B, V) fp32 logits -> (B,) token ids.
+
+        The top-k / top-p filters run in DESCENDING-SORTED logit space and
+        the categorical draw happens there too — the winning sorted slot
+        is then mapped back through the sort's index vector.  Sampling in
+        sorted space keeps every step gather-shaped (no (B, V) scatter,
+        which XLA:TPU would serialize)."""
         if temperature <= 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(
-            jax.random.fold_in(rng, i),
-            logits / temperature, axis=-1).astype(jnp.int32)
+        key = jax.random.fold_in(rng, i)
+        logits = logits / temperature
+        V = logits.shape[-1]
+        if top_k <= 0 and top_p >= 1.0:
+            return jax.random.categorical(
+                key, logits, axis=-1).astype(jnp.int32)
+        # full descending sort (lax.top_k of the whole vocab)
+        srt, idx = lax.top_k(logits, V)
+        neg = jnp.finfo(srt.dtype).min
+        if top_k > 0:
+            keep_k = jnp.arange(V) < min(top_k, V)          # (V,)
+            srt = jnp.where(keep_k[None], srt, neg)
+        if top_p < 1.0:
+            probs = jax.nn.softmax(srt, axis=-1)
+            # exclusive cumulative mass BEFORE each slot: slot survives if
+            # the mass above it is still < p (the top slot always survives)
+            cum = jnp.cumsum(probs, axis=-1) - probs
+            srt = jnp.where(cum < top_p, srt, neg)
+        choice = jax.random.categorical(key, srt, axis=-1)  # sorted slot
+        return jnp.take_along_axis(
+            idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)

@@ -148,6 +148,17 @@ class TestDecode:
         gen = model.generate(params, toks, 1)
         assert gen.shape == (1, 9)
 
+    def test_cache_len_override_is_output_invariant(self):
+        """Extra cache capacity only pads the masked region — greedy
+        tokens must be identical (bench.measure_decode relies on this to
+        pin both timing arms to one capacity)."""
+        model, params, toks = self._setup(b=2, s=8)
+        want = np.asarray(model.generate(params, toks, 6))
+        got = np.asarray(model.generate(params, toks, 6, cache_len=40))
+        np.testing.assert_array_equal(got, want)
+        with pytest.raises(ValueError, match="cache_len"):
+            model.generate(params, toks, 6, cache_len=10)
+
     def test_temperature_sampling_needs_rng_and_varies(self):
         model, params, toks = self._setup(b=4, s=8)
         with pytest.raises(ValueError, match="rng"):
@@ -162,6 +173,88 @@ class TestDecode:
         model, params, _ = self._setup()
         with pytest.raises(ValueError, match="max_positions"):
             model.init_cache(1, TINY.max_positions + 1)
+
+
+class TestSamplingFilters:
+    """top-k / top-p (nucleus) sampling: the filters run in sorted logit
+    space and map back through the sort indices — these tests pin that a
+    sampled token can never come from outside the allowed set, on
+    deliberately UNSORTED logits (the index mapping is the part a bug
+    would silently break)."""
+
+    def _model(self):
+        return gpt.CausalLm(TINY)
+
+    def _draws(self, model, logits, n=64, **kw):
+        key = jax.random.key(0)
+        return {int(model._sample(logits, 1.0, key, i, **kw)[0])
+                for i in range(n)}
+
+    def test_top_k_restricts_support(self):
+        model = self._model()
+        r = np.random.default_rng(3)
+        logits = jnp.asarray(r.normal(size=(1, 16)), jnp.float32)
+        allowed = set(np.asarray(
+            jnp.argsort(logits[0])[::-1][:3]).tolist())
+        got = self._draws(model, logits, top_k=3)
+        assert got <= allowed
+        assert len(got) > 1          # it samples, not argmaxes
+
+    def test_top_k_1_is_argmax(self):
+        model = self._model()
+        logits = jnp.asarray(
+            np.random.default_rng(4).normal(size=(2, 32)), jnp.float32)
+        want = np.asarray(jnp.argmax(logits, -1))
+        for i in range(8):
+            got = np.asarray(model._sample(logits, 1.0, jax.random.key(0),
+                                           i, top_k=1))
+            np.testing.assert_array_equal(got, want)
+
+    def test_top_p_restricts_support(self):
+        model = self._model()
+        # unsorted probs [0.05, 0.5, 0.15, 0.3]: nucleus at p=0.7 keeps
+        # {0.5, 0.3} -> token ids {1, 3} (exclusive-cumulative rule: the
+        # 0.15 slot enters at mass 0.8 >= 0.7)
+        probs = np.array([[0.05, 0.5, 0.15, 0.3]])
+        logits = jnp.asarray(np.log(probs), jnp.float32)
+        got = self._draws(model, logits, n=128, top_p=0.7)
+        assert got == {1, 3}
+
+    def test_top_p_1_is_plain_categorical_support(self):
+        model = self._model()
+        probs = np.array([[0.25, 0.25, 0.25, 0.25]])
+        logits = jnp.asarray(np.log(probs), jnp.float32)
+        got = self._draws(model, logits, n=256, top_p=1.0)
+        assert got == {0, 1, 2, 3}
+
+    def test_combined_filters_intersect(self):
+        model = self._model()
+        probs = np.array([[0.05, 0.4, 0.15, 0.4]])
+        logits = jnp.asarray(np.log(probs), jnp.float32)
+        # top_k=3 allows {1, 3, 2}; top_p=0.5 keeps the first sorted slot
+        # (0.4) plus the second (enters at 0.4 < 0.5) -> {1, 3}
+        got = self._draws(model, logits, n=128, top_k=3, top_p=0.5)
+        assert got == {1, 3}
+
+    def test_generate_with_filters(self):
+        model = self._model()
+        params = model.init(jax.random.key(0))
+        toks = _tokens(b=2, s=8)
+        gen = jax.jit(lambda p, t: model.generate(
+            p, t, 6, temperature=0.9, top_k=40, top_p=0.95,
+            rng=jax.random.key(7)))(params, toks)
+        assert gen.shape == (2, 14)
+        assert int(gen.min()) >= 0 and int(gen.max()) < TINY.vocab_size
+
+    def test_filter_guards(self):
+        model = self._model()
+        params = model.init(jax.random.key(0))
+        toks = _tokens(b=1, s=8)
+        with pytest.raises(ValueError, match="temperature > 0"):
+            model.generate(params, toks, 2, top_k=5)
+        with pytest.raises(ValueError, match="top_p"):
+            model.generate(params, toks, 2, temperature=1.0, top_p=0.0,
+                           rng=jax.random.key(0))
 
 
 class TestShardedDecode:
