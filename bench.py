@@ -61,7 +61,9 @@ def measure_bert(batch_size: int, steps: int, precision: str,
                  scan_steps: int, seq_len: int = 128,
                  ce_impl: str = "auto", ce_chunk: int = 2048,
                  model_name: str = "bert_base", remat: bool = False,
-                 params_bf16: bool = False) -> dict:
+                 params_bf16: bool = False, prng_impl: str = "threefry",
+                 fused_qkv: bool = False,
+                 flash_min_seq: int | None = None) -> dict:
     """BERT-base MLM train-step throughput (BASELINE config 5) via the
     GSPMD path — adamw, tied-decoder MLM loss, scanned dispatches.
     ``model_name="moe_bert"`` swaps in the capacity-routed MoE variant
@@ -78,14 +80,17 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
     from mpi_tensorflow_tpu.train import gspmd
 
-    cfg = Config(precision=precision)
+    cfg = Config(precision=precision, prng_impl=prng_impl)
     mesh = meshlib.make_mesh()
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
     bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype,
                       ce_impl=ce_impl, ce_chunk=ce_chunk, remat=remat,
+                      fused_qkv=fused_qkv,
                       max_positions=max(bert.BERT_BASE.max_positions,
-                                        seq_len))
+                                        seq_len),
+                      **({} if flash_min_seq is None
+                         else {"flash_min_seq": flash_min_seq}))
     if model_name == "moe_bert":
         from mpi_tensorflow_tpu.models import moe
 
@@ -122,8 +127,9 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     from mpi_tensorflow_tpu.utils import engagement
 
     engagement.reset()   # snapshot below reflects THIS trace only
-    sec = _measure_scanned(multi, state, batches, labels, jax.random.key(1),
-                           K, max(1, steps // K), warmup_calls=2)
+    sec = _measure_scanned(multi, state, batches, labels,
+                           cfg.make_train_key(1), K, max(1, steps // K),
+                           warmup_calls=2)
     dtype_name = jnp.dtype(bcfg.dtype).name
     causal = model_name == "gpt_base"
     return {
@@ -144,13 +150,17 @@ def measure_bert(batch_size: int, steps: int, precision: str,
         "ce_impl": ce_impl,
         "ce_chunk": ce_chunk,
         "params_bf16": params_bf16,
+        "prng_impl": prng_impl,
+        "fused_qkv": fused_qkv,
+        "flash_min_seq": bcfg.flash_min_seq,
         "platform": jax.devices()[0].platform,
     }
 
 
 def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
             precision: str = "fp32", scan_steps: int = 50,
-            model_name: str = "mnist_cnn", remat: bool = False) -> dict:
+            model_name: str = "mnist_cnn", remat: bool = False,
+            prng_impl: str = "threefry") -> dict:
     """Train-step throughput for the image families.
 
     ``scan_steps > 0`` stages K batches on device and runs K steps per
@@ -172,7 +182,7 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     in_shape = spec["shape"]
     cfg = Config(batch_size=batch_size, precision=precision,
                  model=model_name, num_classes=spec["classes"],
-                 image_size=in_shape[0], remat=remat)
+                 image_size=in_shape[0], remat=remat, prng_impl=prng_impl)
     mesh = meshlib.make_mesh()
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
@@ -183,7 +193,7 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     rng = np.random.default_rng(0)
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    key = jax.random.key(0)
+    key = cfg.make_train_key(0)
     if scan_steps > 0:
         scan_steps = min(scan_steps, steps)   # never exceed the requested work
         train_step = step_lib.make_multi_train_step(model, cfg, mesh,
@@ -262,22 +272,40 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
             times.append(time.perf_counter() - t0)
         return sorted(times)[len(times) // 2]
 
-    # prefill is timed separately and subtracted: the decode metric must
-    # not scale with --prompt-len (a prefill-heavy call would otherwise
-    # report mostly prompt cost as per-token decode latency)
-    cache0 = model.init_cache(batch_size, prompt_len + new_tokens)
+    # decode time comes from the SLOPE between two generate lengths: both
+    # arms pay the identical prefill + dispatch/tunnel RTT, so both cancel
+    # in the difference.  (The first design subtracted a separately timed
+    # prefill call — on the tunneled device the ~100ms RTT dwarfs the
+    # ~1ms decode, the subtraction collapsed into the noise floor and the
+    # 1e-9 clamp reported 1e12 tok/s.)
+    n_short = max(8, new_tokens // 8)
+    n_long = n_short + new_tokens
+    # BOTH arms pin the same cache capacity: each decode step attends over
+    # the full (masked) cache buffer, so per-step cost scales with the
+    # capacity — different capacities would bias the slope
+    L = prompt_len + n_long
+    cache0 = model.init_cache(batch_size, L)
     prefill = jax.jit(
         lambda p, t: model.forward_with_cache(p, t, cache0, 0)[0])
-    gen = jax.jit(lambda p, t: model.generate(p, t, new_tokens))
+    gen_short = jax.jit(
+        lambda p, t: model.generate(p, t, n_short, cache_len=L))
+    gen_long = jax.jit(
+        lambda p, t: model.generate(p, t, n_long, cache_len=L))
     prefill_sec = median_time(lambda: prefill(params, prompt))
-    gen_sec = median_time(lambda: gen(params, prompt))
-    decode_sec = max(gen_sec - prefill_sec, 1e-9)
+    short_sec = median_time(lambda: gen_short(params, prompt))
+    long_sec = median_time(lambda: gen_long(params, prompt))
+    per_tok = (long_sec - short_sec) / new_tokens
+    degenerate = per_tok <= 0    # a tenancy stall ordered the arms backwards
     return {
         "model": "gpt_base",
-        "decode_tokens_per_sec": batch_size * new_tokens / decode_sec,
-        "per_token_ms": decode_sec / new_tokens * 1e3,
+        "decode_tokens_per_sec": (batch_size / per_tok if not degenerate
+                                  else float("nan")),
+        "per_token_ms": per_tok * 1e3,
+        "timing_degenerate": degenerate,
+        "decode_lengths": [n_short, n_long],
+        "gen_short_ms": short_sec * 1e3,
+        "gen_long_ms": long_sec * 1e3,
         "prefill_ms": prefill_sec * 1e3,
-        "end_to_end_ms": gen_sec * 1e3,
         "batch_size": batch_size,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
@@ -429,6 +457,20 @@ def main(argv=None) -> int:
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize residual blocks / encoder layers "
                          "(frees HBM for larger batches)")
+    ap.add_argument("--flash-min-seq", type=int, default=None,
+                    help="engage the Pallas flash-attention kernel only at "
+                         "seq_len >= this (default: the model's measured "
+                         "crossover, models/bert.py flash_min_seq; 0 = "
+                         "always engage — the kernel A/B arm)")
+    ap.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
+                    default="threefry",
+                    help="dropout-mask PRNG for the timed step: threefry "
+                         "(JAX default) or rbg/unsafe_rbg (XLA "
+                         "RngBitGenerator — cheaper mask generation; a BERT "
+                         "step generates 25 (B,S,E) masks)")
+    ap.add_argument("--fused-qkv", action="store_true",
+                    help="compute q,k,v via one stacked (E,3HD) matmul per "
+                         "layer instead of three (transformer families)")
     ap.add_argument("--params-bf16", action="store_true",
                     help="store live parameters in bfloat16 with fp32 "
                          "master weights in the optimizer (halves weight "
@@ -451,6 +493,23 @@ def main(argv=None) -> int:
         if args.seq_len < 1:
             ap.error(f"--seq-len must be >= 1, got {args.seq_len}")
 
+    if args.fused_qkv and (args.mode != "train" or args.model not in
+                           ("bert_base", "moe_bert", "gpt_base")):
+        ap.error("--fused-qkv applies to the transformer families in train "
+                 "mode only — other paths would silently ignore it")
+    if args.prng != "threefry" and args.mode != "train":
+        ap.error("--prng shapes the training dropout stream; decode/"
+                 "allreduce modes have no dropout and would silently "
+                 "ignore it")
+    if args.prng != "threefry" and args.record_baseline:
+        ap.error("--record-baseline stores the canonical reference-"
+                 "semantics run; keep the default threefry stream")
+    if args.flash_min_seq is not None and (
+            args.mode != "train" or args.model not in
+            ("bert_base", "moe_bert", "gpt_base")):
+        ap.error("--flash-min-seq applies to the transformer families in "
+                 "train mode only — other paths would silently ignore it")
+
     if not _backend_reachable():
         # one parseable line beats an unbounded hang for whoever runs this
         print(json.dumps({
@@ -470,12 +529,14 @@ def main(argv=None) -> int:
                            new_tokens=args.new_tokens,
                            precision=args.precision,
                            iters=max(1, (args.steps or 5)))
+        v = r["decode_tokens_per_sec"]
         print(json.dumps({
             "metric": "GPT-base greedy decode throughput (KV cache)",
-            "value": round(r["decode_tokens_per_sec"], 1),
+            "value": round(v, 1) if v == v else None,   # NaN -> null
             "unit": "tokens/sec",
             "vs_baseline": None,
-            "detail": r,
+            "detail": {k: (None if isinstance(val, float) and val != val
+                           else val) for k, val in r.items()},
         }))
         return 0
 
@@ -536,7 +597,9 @@ def main(argv=None) -> int:
                                        else spec["seq"]),
                               ce_impl=args.ce,
                               ce_chunk=args.ce_chunk, model_name=args.model,
-                              remat=args.remat, params_bf16=args.params_bf16)
+                              remat=args.remat, params_bf16=args.params_bf16,
+                              prng_impl=args.prng, fused_qkv=args.fused_qkv,
+                              flash_min_seq=args.flash_min_seq)
         label = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",
                  "gpt_base": "GPT-base causal LM"}.get(args.model,
                                                        "BERT-base MLM")
@@ -552,7 +615,8 @@ def main(argv=None) -> int:
 
     result = measure(batch_size=batch, steps=steps,
                      precision=args.precision, scan_steps=scan,
-                     model_name=args.model, remat=args.remat)
+                     model_name=args.model, remat=args.remat,
+                     prng_impl=args.prng)
 
     if args.record_baseline:
         _record_baseline("train", result)

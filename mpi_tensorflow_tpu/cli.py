@@ -105,6 +105,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--precision", choices=["fp32", "bf16"], default=d.precision,
                    help="compute dtype for matmuls/convs (bf16 doubles MXU "
                         "throughput; params and loss stay fp32)")
+    p.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
+                   default=d.prng_impl,
+                   help="dropout-mask PRNG: threefry (JAX default, "
+                        "bit-reproducible) or rbg/unsafe_rbg (XLA "
+                        "RngBitGenerator — much cheaper mask generation on "
+                        "TPU; a BERT step generates 25 (B,S,E) masks). "
+                        "Parameter init always uses threefry")
     return p
 
 
@@ -131,7 +138,8 @@ def config_from_args(args) -> Config:
         vocab_file=args.vocab_file,
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         metrics_dir=args.metrics_dir,
-        precision=args.precision, grad_accum=args.grad_accum,
+        precision=args.precision, prng_impl=args.prng,
+        grad_accum=args.grad_accum,
         pp_schedule=args.pp_schedule,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None

@@ -105,6 +105,19 @@ class Config:
                                   # throughout)
 
     # --- misc ---
+    prng_impl: str = "threefry"   # PRNG for the training rng stream
+                                  # (dropout masks): "threefry" (JAX default,
+                                  # splittable, bit-reproducible across
+                                  # backends) | "rbg" | "unsafe_rbg" (XLA
+                                  # RngBitGenerator — far cheaper mask
+                                  # generation on TPU; rbg keys also shard
+                                  # cleanly under GSPMD).  A BERT train step
+                                  # runs 25 (B,S,E) mask generations, so the
+                                  # generator choice is a first-order
+                                  # throughput knob (scripts/bert_diagnose.py
+                                  # measures the delta); parameter INIT always
+                                  # uses threefry so init is bit-identical
+                                  # across prng arms
     seed: int = 1                 # the reference seeds everything with 1
                                   # (mpipy.py:40, 43, 48, 52, 166)
     dropout_rate: float = 0.5     # mpipy.py:166
@@ -117,6 +130,16 @@ class Config:
     def num_channels(self) -> int:
         """Input channels (1 for MNIST)."""
         return 1
+
+    def make_train_key(self, seed: int):
+        """Training rng stream keyed per ``prng_impl``.  The impl travels
+        with the key through every ``fold_in`` inside the jitted step, so
+        this one call site decides the dropout-mask generator."""
+        import jax
+
+        impl = {"threefry": "threefry2x32"}.get(self.prng_impl,
+                                                self.prng_impl)
+        return jax.random.key(seed, impl=impl)
 
     @property
     def compute_dtype(self):

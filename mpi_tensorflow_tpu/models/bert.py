@@ -74,6 +74,18 @@ class BertConfig:
                                   # (E, HD) matmuls — fewer, larger MXU
                                   # dispatches; parameters stay separate
                                   # (checkpoints/sharding rules unchanged)
+    flash_min_seq: int = 4096     # engage the Pallas flash kernel only at
+                                  # sequence length >= this; below it XLA's
+                                  # fused dense attention wins on measured
+                                  # hardware (TPU v5e, BASELINE.md round 3:
+                                  # XLA beats flash 121.3k vs 100.3k tok/s
+                                  # at S=128 and 30.7k vs 27.5k at S=2048
+                                  # — the kernel's unfused epilogue + lse
+                                  # round-trips cost more than the (S, S)
+                                  # score materialization saves until the
+                                  # scores stop fitting in VMEM-friendly
+                                  # tiles).  0 = always engage (kernel
+                                  # A/B measurement arms)
 
     @property
     def head_dim(self) -> int:
@@ -250,10 +262,16 @@ class BertMlm:
     def _attention(self, q, k, v):
         """q,k,v: (B, H, S, D).  Sequence-parallel attention (ring or
         Ulysses per ``cfg.sp_impl``) over the seq axis when the mesh shards
-        it; otherwise the Pallas flash kernel on TPU (falls back to dense
-        when shapes/platform don't allow it)."""
+        it; otherwise the Pallas flash kernel on TPU for sequences at or
+        above ``cfg.flash_min_seq``, XLA's fused dense attention below it
+        (the measured winner at short/medium S — see flash_min_seq)."""
         on_tpu = jax.devices()[0].platform == "tpu"
         causal = self.causal
+        # captured OUTSIDE shard_map: the threshold compares the FULL
+        # sequence length, not a shard's slice of it
+        S_full = q.shape[2]
+        flash_ok = self.use_flash and on_tpu \
+            and S_full >= self.cfg.flash_min_seq
         if self.mesh is not None and self.mesh.shape.get("seq", 1) > 1:
             specs = P("data" if self.mesh.shape.get("data", 1) > 1 else None,
                       "model" if self.mesh.shape.get("model", 1) > 1 else None,
@@ -264,9 +282,10 @@ class BertMlm:
                     from mpi_tensorflow_tpu.parallel import ulysses
 
                     inner_attn = None
-                    if self.use_flash and on_tpu:
-                        # each shard sees the FULL sequence for its heads —
-                        # exactly where the Pallas kernel pays off
+                    if flash_ok:
+                        # post-all-to-all each shard sees the FULL sequence
+                        # for its head slice — S_full is the right length
+                        # for the kernel threshold
                         from mpi_tensorflow_tpu.ops import \
                             flash_attention as fa
 
@@ -290,7 +309,7 @@ class BertMlm:
             return jax.shard_map(inner, mesh=self.mesh,
                                  in_specs=(specs, specs, specs),
                                  out_specs=specs, check_vma=False)(q, k, v)
-        if self.use_flash and on_tpu:
+        if flash_ok:
             # any S: the kernel pads/masks to the block size internally;
             # kernel_supported() guards against a Mosaic regression (falls
             # back to XLA attention instead of failing the train step)

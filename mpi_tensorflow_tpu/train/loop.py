@@ -141,7 +141,7 @@ def train(config: Config, model=None, splits: Optional[mnist.Splits] = None,
         state, start_step = hooks.resume(state)
 
     batch_sharding = NamedSharding(mesh, P("data"))
-    rng = jax.random.key(config.seed + 1)
+    rng = config.make_train_key(config.seed + 1)
     timer = StepTimer(warmup_steps=1)
     history = []
     if verbose:

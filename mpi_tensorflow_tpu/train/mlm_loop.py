@@ -130,7 +130,7 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     if config.resume:
         state, start_step = hooks.resume(state)
 
-    rng = jax.random.key(config.seed + 2)
+    rng = config.make_train_key(config.seed + 2)
     timer = StepTimer(warmup_steps=1)
     history = []
     if verbose:

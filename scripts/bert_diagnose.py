@@ -68,8 +68,11 @@ def make_inputs(K):
 
 def build(dropout=0.1, use_flash=True, fused_qkv=False):
     mesh = meshlib.make_mesh()
+    # flash_min_seq=0 keeps the use_flash contrast meaningful at S=128:
+    # True = forced kernel, False = XLA dense (the shipping default since
+    # the threshold landed — round-3 measurements put XLA ahead at short S)
     cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout,
-                     fused_qkv=fused_qkv)
+                     fused_qkv=fused_qkv, flash_min_seq=0)
     model = bert.BertMlm(cfg, mesh=mesh, use_flash=use_flash)
     tx = optax.adamw(1e-4)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)

@@ -1,9 +1,12 @@
 #!/usr/bin/env python
-"""Capture a device trace of the BERT train step and print the op-level
-time breakdown (xprof framework_op_stats), grouped by op category.
+"""Capture a device trace of a train step and print the op-level time
+breakdown (xprof framework_op_stats), grouped by op category.
 
 Answers "where do the milliseconds go" directly — the diagnosis
 scripts/bert_diagnose.py locates the stall by ablation; this names it.
+``--model bert_base`` (default) profiles the flagship MLM step;
+``--model resnet50`` profiles the image step at its best-known config
+(b128 + remat, BASELINE.md round-3 table).
 """
 
 from __future__ import annotations
@@ -28,11 +31,13 @@ from mpi_tensorflow_tpu.train import gspmd
 B, S, K = 64, 128, 8
 
 
-def main():
+def build_bert(mesh):
     dropout = float(os.environ.get("PROF_DROPOUT", "0.1"))
     use_flash = os.environ.get("PROF_FLASH", "1") == "1"
-    mesh = meshlib.make_mesh()
-    cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout)
+    # flash_min_seq=0 keeps PROF_FLASH meaningful at S=128 (the default
+    # threshold would force XLA attention regardless — see bert_diagnose)
+    cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout,
+                     flash_min_seq=0)
     model = bert.BertMlm(cfg, mesh=mesh, use_flash=use_flash)
     tx = optax.adamw(1e-4)
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
@@ -43,6 +48,41 @@ def main():
     batches = {"tokens": jnp.asarray(toks.reshape(shape)),
                "mask": jnp.asarray(mask.reshape(shape))}
     labels = jnp.asarray(tgts.reshape(shape))
+    return multi, state, batches, labels
+
+
+def build_resnet50(mesh):
+    from mpi_tensorflow_tpu.config import Config
+    from mpi_tensorflow_tpu.train import loop, step as step_lib
+
+    b = int(os.environ.get("PROF_BATCH", "128"))
+    cfg = Config(batch_size=b, precision="bf16", model="resnet50",
+                 num_classes=1000, image_size=224,
+                 remat=os.environ.get("PROF_REMAT", "1") == "1")
+    model = loop.build_model(cfg)
+    state = step_lib.init_state(model, jax.random.key(cfg.seed))
+    multi = step_lib.make_multi_train_step(model, cfg, mesh,
+                                           decay_steps=50000)
+    rng = np.random.default_rng(0)
+    kk = max(2, K // 4)   # 224^2 inputs: keep the staged bank in HBM
+    batches = jnp.asarray(rng.normal(size=(kk, b, 224, 224, 3))
+                          .astype(np.float32) * 0.3)
+    labels = jnp.asarray(rng.integers(0, 1000, size=(kk, b))
+                         .astype(np.int64))
+    return multi, state, batches, labels
+
+
+def main():
+    global K
+    model_name = "bert_base"
+    if "--model" in sys.argv:
+        model_name = sys.argv[sys.argv.index("--model") + 1]
+    mesh = meshlib.make_mesh()
+    if model_name == "resnet50":
+        multi, state, batches, labels = build_resnet50(mesh)
+        K = batches.shape[0]
+    else:
+        multi, state, batches, labels = build_bert(mesh)
 
     # warmup/compile
     st, m = multi(state, batches, labels, jax.random.key(1))

@@ -30,8 +30,22 @@ LOG = os.path.join(REPO, "MEASURE_LOG.jsonl")
 STAMPS = os.path.join(REPO, ".tpu_done")
 
 
+def _json_safe(obj):
+    """NaN/Inf -> None, recursively: bare json.dumps writes literal
+    ``NaN`` tokens that strict consumers (jq, JSON.parse) abort on — the
+    repo convention (utils/metrics_writer.py)."""
+    if isinstance(obj, float) and (obj != obj or obj in
+                                   (float("inf"), float("-inf"))):
+        return None
+    if isinstance(obj, dict):
+        return {k: _json_safe(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_json_safe(v) for v in obj]
+    return obj
+
+
 def emit(obj):
-    line = json.dumps(obj)
+    line = json.dumps(_json_safe(obj))
     print(line, flush=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
@@ -53,7 +67,36 @@ def run_item(name, fn):
     open(os.path.join(STAMPS, name), "w").close()
 
 
-ITEMS = ["bert_diagnose", "bert_profile", "resnet50_b32",
+def _sub_env():
+    """Subprocess env with the repo first on PYTHONPATH: the child's
+    sys.path[0] is scripts/, not the repo — without this the package
+    import dies (exactly how the first window lost both diagnosis items:
+    ModuleNotFoundError, rc=1, wrongly stamped done)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def run_script(script, tail=4000, extra=()):
+    """Run a scripts/ diagnostic in a subprocess; RAISE on a non-zero
+    exit so run_item does not stamp — a failed diagnostic must retry
+    next window, like every other item."""
+    r = subprocess.run([sys.executable, os.path.join("scripts", script),
+                        *extra],
+                       capture_output=True, text=True, timeout=1500,
+                       env=_sub_env())
+    if r.returncode != 0:
+        raise RuntimeError(f"{script} rc={r.returncode}: "
+                           f"{r.stderr[-600:]}")
+    return {"stdout": r.stdout[-tail:], "stderr": r.stderr[-1000:],
+            "rc": r.returncode}
+
+
+ITEMS = ["bert_diagnose", "bert_profile", "resnet_profile",
+         "bert_rbg", "bert_fused_qkv",
+         "bert_rbg_fused", "bert_b128", "bert_b256",
+         "bert_s2048_flash_remat", "bert_s4096_flash", "bert_s4096_xla",
+         "resnet50_b32",
          "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
          "gpt_base", "decode", "bert_s512", "bert_s2048", "mnist",
          "resnet20", "allreduce", "bert_noflash", "bert_s2048_noflash"]
@@ -68,26 +111,45 @@ def main():
     import bench
 
     # -- 1. stall diagnosis: ablations share the client; each is scan=16
-    def diag():
-        r = subprocess.run([sys.executable, "scripts/bert_diagnose.py"],
-                           capture_output=True, text=True, timeout=1500)
-        return {"stdout": r.stdout[-4000:], "stderr": r.stderr[-1000:],
-                "rc": r.returncode}
-
     # the diagnose/profile scripts import-and-init their own client; they
     # still run as subprocesses (their cost_analysis/profiler state should
     # not leak into the bench numbers) but FIRST in the window
-    run_item("bert_diagnose", diag)
-
-    def prof():
-        r = subprocess.run([sys.executable, "scripts/bert_profile.py"],
-                           capture_output=True, text=True, timeout=1500)
-        return {"stdout": r.stdout[-6000:], "stderr": r.stderr[-1000:],
-                "rc": r.returncode}
-
-    run_item("bert_profile", prof)
+    run_item("bert_diagnose", lambda: run_script("bert_diagnose.py", 4000))
+    run_item("bert_profile", lambda: run_script("bert_profile.py", 6000))
+    run_item("resnet_profile", lambda: run_script(
+        "bert_profile.py", 6000, extra=("--model", "resnet50")))
 
     # -- 2. in-process queue: one client init for everything below
+    # flagship candidate arms first: if the diagnosis names dropout-PRNG
+    # or QKV-dispatch cost as the stall, these are the BENCH-grade numbers
+    # for the fix (rbg = cheap RngBitGenerator masks; fused = one (E,3HD)
+    # matmul per layer); b128/b256 probe the MFU-vs-batch ceiling
+    run_item("bert_rbg", lambda: bench.measure_bert(
+        batch_size=64, steps=32, precision="bf16", scan_steps=4,
+        prng_impl="rbg"))
+    run_item("bert_fused_qkv", lambda: bench.measure_bert(
+        batch_size=64, steps=32, precision="bf16", scan_steps=4,
+        fused_qkv=True))
+    run_item("bert_rbg_fused", lambda: bench.measure_bert(
+        batch_size=64, steps=32, precision="bf16", scan_steps=4,
+        prng_impl="rbg", fused_qkv=True))
+    run_item("bert_b128", lambda: bench.measure_bert(
+        batch_size=128, steps=16, precision="bf16", scan_steps=4))
+    run_item("bert_b256", lambda: bench.measure_bert(
+        batch_size=256, steps=8, precision="bf16", scan_steps=2))
+    # flash-vs-XLA crossover hunt: the measured arms put XLA ahead at
+    # S=128 (121.3k vs 100.3k) and S=2048 (30.7k+remat vs 27.5k bare);
+    # these make the S=2048 comparison apples-to-apples (both remat) and
+    # probe S=4096, the default threshold
+    run_item("bert_s2048_flash_remat", lambda: bench.measure_bert(
+        batch_size=4, steps=8, precision="bf16", scan_steps=2,
+        seq_len=2048, remat=True, flash_min_seq=0))
+    run_item("bert_s4096_flash", lambda: bench.measure_bert(
+        batch_size=2, steps=8, precision="bf16", scan_steps=2,
+        seq_len=4096, remat=True, flash_min_seq=0))
+    run_item("bert_s4096_xla", lambda: bench.measure_bert(
+        batch_size=2, steps=8, precision="bf16", scan_steps=2,
+        seq_len=4096, remat=True, flash_min_seq=1 << 30))
     run_item("resnet50_b32", lambda: bench.measure(
         batch_size=32, steps=48, precision="bf16", scan_steps=8,
         model_name="resnet50"))
@@ -103,7 +165,17 @@ def main():
     run_item("gpt_base", lambda: bench.measure_bert(
         batch_size=64, steps=32, precision="bf16", scan_steps=4,
         model_name="gpt_base"))
-    run_item("decode", lambda: bench.measure_decode(precision="bf16"))
+
+    def decode_item():
+        d = bench.measure_decode(precision="bf16")
+        if d.get("timing_degenerate"):
+            # a tenancy stall ordered the timing arms backwards — raise
+            # so the flagged-useless number is recorded but NOT stamped
+            raise RuntimeError("degenerate decode timing "
+                               f"(slope <= 0): {d}")
+        return d
+
+    run_item("decode", decode_item)
     # long-context flagship: S=512 and S=2048 — the regime the flash
     # fwd+bwd kernels target (attention is O(S^2); at S=128 it is noise)
     run_item("bert_s512", lambda: bench.measure_bert(

@@ -21,8 +21,10 @@ from mpi_tensorflow_tpu.utils import engagement
 pytestmark = pytest.mark.quick
 
 
-def _tiny_loss():
-    cfg = bert.BERT_TINY
+def _tiny_loss(**cfg_overrides):
+    import dataclasses
+
+    cfg = dataclasses.replace(bert.BERT_TINY, **cfg_overrides)
     model = bert.BertMlm(cfg)
     params = model.init(jax.random.key(0))
     rng = np.random.default_rng(0)
@@ -56,12 +58,25 @@ def test_attention_record_flips_with_probe(monkeypatch):
         lambda q, k, v, causal=False, scale=None:
         ring.dense_attention(q, k, v, causal=causal))
     engagement.reset()
-    _tiny_loss()
+    _tiny_loss(flash_min_seq=0)
     assert engagement.snapshot()["attention"] == "flash"
 
     monkeypatch.setattr(fa, "kernel_supported", lambda *a, **k: False)
     engagement.reset()
-    _tiny_loss()
+    _tiny_loss(flash_min_seq=0)
+    assert engagement.snapshot()["attention"] == "xla_dense"
+
+
+def test_short_seq_prefers_xla_even_with_kernel_available(monkeypatch):
+    """The flash_min_seq policy: below the threshold the step uses XLA
+    dense attention EVEN when the kernel probe passes — the measured
+    winner at short S (BASELINE.md round 3: 121.3k vs 100.3k tok/s at
+    S=128).  The record must say so."""
+    monkeypatch.setattr(jax, "devices",
+                        lambda *a: [SimpleNamespace(platform="tpu")])
+    monkeypatch.setattr(fa, "kernel_supported", lambda *a, **k: True)
+    engagement.reset()
+    _tiny_loss()                     # default flash_min_seq (4096) >> S=32
     assert engagement.snapshot()["attention"] == "xla_dense"
 
 

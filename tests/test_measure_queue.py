@@ -57,6 +57,57 @@ class TestRunItem:
         for line in open(queue_mod.LOG):
             json.loads(line)                          # must not raise
 
+    def test_failed_script_item_raises_and_does_not_stamp(self, queue_mod,
+                                                          monkeypatch):
+        """The diag/profile items run as subprocesses; a child that dies
+        (e.g. ModuleNotFoundError — the first window's actual failure)
+        must RAISE so run_item records the error without stamping."""
+        captured = {}
+
+        class Dead:
+            returncode = 1
+            stdout = ""
+            stderr = "ModuleNotFoundError: No module named 'x'"
+
+        def fake_run(cmd, **kw):
+            captured["env"] = kw.get("env")
+            return Dead()
+
+        monkeypatch.setattr(queue_mod.subprocess, "run", fake_run)
+        queue_mod.run_item(
+            "diag", lambda: queue_mod.run_script("bert_diagnose.py"))
+        assert not os.path.exists(os.path.join(queue_mod.STAMPS, "diag"))
+        recs = [json.loads(line) for line in open(queue_mod.LOG)]
+        assert "error" in recs[0]
+        assert "ModuleNotFoundError" in recs[0]["error"]
+        # the child env must carry the repo first on PYTHONPATH (the
+        # first-window regression: child sys.path[0] is scripts/)
+        assert captured["env"]["PYTHONPATH"].startswith(queue_mod.REPO)
+
+    def test_run_script_success_returns_tails(self, queue_mod, monkeypatch):
+        class Ok:
+            returncode = 0
+            stdout = "x" * 5000
+            stderr = ""
+
+        monkeypatch.setattr(queue_mod.subprocess, "run",
+                            lambda *a, **k: Ok())
+        out = queue_mod.run_script("bert_profile.py", tail=100)
+        assert out["rc"] == 0 and len(out["stdout"]) == 100
+
+    def test_emit_writes_strict_json_for_nan(self, queue_mod):
+        """A degenerate measurement (NaN throughput) must serialize as
+        null — literal NaN tokens abort strict consumers (jq), the repo
+        convention (utils/metrics_writer.py)."""
+        queue_mod.emit({"item": "decode",
+                        "detail": {"tps": float("nan"),
+                                   "arr": [1.0, float("inf")]}})
+        line = open(queue_mod.LOG).read()
+        assert "NaN" not in line and "Infinity" not in line
+        rec = json.loads(line)
+        assert rec["detail"]["tps"] is None
+        assert rec["detail"]["arr"] == [1.0, None]
+
     def test_check_done_semantics(self, queue_mod):
         for name in queue_mod.ITEMS[:-1]:
             open(os.path.join(queue_mod.STAMPS, name), "w").close()

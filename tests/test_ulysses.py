@@ -100,7 +100,8 @@ class TestUlyssesAttention:
         from mpi_tensorflow_tpu.parallel import mesh as meshlib
 
         cfg = dataclasses.replace(bert.BERT_TINY, sp_impl="ulysses",
-                                  heads=8)   # divisible by the seq axis
+                                  heads=8,   # divisible by the seq axis
+                                  flash_min_seq=0)   # engage at any S
         mesh = meshlib.make_mesh({"data": 1, "seq": 8})
         monkeypatch.setattr(ulysses_mod, "ulysses_attention", spy)
         # pretend we're on TPU for the gate (after building the mesh —
