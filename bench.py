@@ -132,7 +132,17 @@ def measure_bert(batch_size: int, steps: int, precision: str,
                            warmup_calls=2)
     dtype_name = jnp.dtype(bcfg.dtype).name
     causal = model_name == "gpt_base"
+    from mpi_tensorflow_tpu.utils import flops as flops_lib
+
+    # MoE routes each token through ONE expert of the same width, so the
+    # dense formula holds per token; causal counts every position at the
+    # head
+    step_flops = flops_lib.transformer_train_flops(
+        bcfg, batch_size, seq_len,
+        head_positions=seq_len if causal else None)
     return {
+        "model_flops_per_step": step_flops,
+        "mfu_pct": flops_lib.mfu_pct(step_flops, sec, precision),
         "model": model_name,
         # which implementations the compiled step actually engaged — an
         # XLA fallback must never masquerade as a kernel number (VERDICT r2)
@@ -227,10 +237,15 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
             lambda i: (batches[i % n_banks], labels[i % n_banks], key),
             iters=steps, warmup=warmup)
 
+    from mpi_tensorflow_tpu.utils import flops as flops_lib
+
+    step_flops = flops_lib.image_train_flops(model_name, batch_size)
     return {
         "model": model_name,
         "images_per_sec": global_b / sec_per_step,
         "images_per_sec_per_chip": batch_size / sec_per_step,
+        "model_flops_per_step": step_flops,
+        "mfu_pct": flops_lib.mfu_pct(step_flops, sec_per_step, precision),
         "step_time_ms": sec_per_step * 1e3,
         "num_devices": ndev,
         "batch_size_per_chip": batch_size,
@@ -529,15 +544,16 @@ def main(argv=None) -> int:
                            new_tokens=args.new_tokens,
                            precision=args.precision,
                            iters=max(1, (args.steps or 5)))
+        from mpi_tensorflow_tpu.utils.jsonsafe import json_safe
+
         v = r["decode_tokens_per_sec"]
-        print(json.dumps({
+        print(json.dumps(json_safe({
             "metric": "GPT-base greedy decode throughput (KV cache)",
             "value": round(v, 1) if v == v else None,   # NaN -> null
             "unit": "tokens/sec",
             "vs_baseline": None,
-            "detail": {k: (None if isinstance(val, float) and val != val
-                           else val) for k, val in r.items()},
-        }))
+            "detail": r,
+        })))
         return 0
 
     if args.mode == "allreduce":

@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--precision", choices=["fp32", "bf16"], default=d.precision,
                    help="compute dtype for matmuls/convs (bf16 doubles MXU "
                         "throughput; params and loss stay fp32)")
+    p.add_argument("--optimizer", choices=["adamw", "lamb"],
+                   default=d.optimizer,
+                   help="transformer-family optimizer (lamb = layer-wise "
+                        "trust ratios, the large-batch BERT recipe); the "
+                        "image families keep the reference's momentum SGD")
     p.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
                    default=d.prng_impl,
                    help="dropout-mask PRNG: threefry (JAX default, "
@@ -139,7 +144,7 @@ def config_from_args(args) -> Config:
         checkpoint_dir=args.checkpoint_dir, resume=args.resume,
         metrics_dir=args.metrics_dir,
         precision=args.precision, prng_impl=args.prng,
-        grad_accum=args.grad_accum,
+        optimizer=args.optimizer, grad_accum=args.grad_accum,
         pp_schedule=args.pp_schedule,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
@@ -165,6 +170,12 @@ def main(argv=None) -> int:
             f"would silently ignore it")
     if config.vocab_file and not config.text_file:
         raise SystemExit("--vocab-file only applies with --text-file")
+    if config.optimizer != "adamw" and config.model not in (
+            "bert_base", "moe_bert", "gpt_base"):
+        raise SystemExit(
+            f"--optimizer {config.optimizer} applies to the transformer "
+            f"families; the image families train with the reference's "
+            f"momentum SGD (mpipy.py:65) and would silently ignore it")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

@@ -104,6 +104,12 @@ class Config:
                                   # with the reference (mpipy.py is float32
                                   # throughout)
 
+    optimizer: str = "adamw"      # transformer-family optimizer: "adamw"
+                                  # | "lamb" (layer-wise trust ratios, the
+                                  # large-batch BERT recipe — You et al.
+                                  # 2019).  The image families keep the
+                                  # reference's momentum SGD (mpipy.py:65)
+
     # --- misc ---
     prng_impl: str = "threefry"   # PRNG for the training rng stream
                                   # (dropout masks): "threefry" (JAX default,

@@ -110,9 +110,12 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
 
     # warmup-linear adamw is the transformer default (VERDICT r2 #7: the
     # reference's exponential decay, mpipy.py:60-64, serves the image
-    # families; adam needs warmup to survive its early-variance phase)
-    tx = opt_lib.transformer_tx(learning_rate, num_steps,
-                                schedule=lr_schedule)
+    # families; adam needs warmup to survive its early-variance phase);
+    # --optimizer lamb swaps in layer-wise trust ratios for large-batch
+    # scale-out
+    tx = opt_lib.transformer_tx(
+        learning_rate, num_steps, schedule=lr_schedule,
+        optimizer=getattr(config, "optimizer", "adamw"))
     state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
                                    mesh)
     train_step = gspmd.make_gspmd_train_step(

@@ -121,12 +121,19 @@ def transformer_tx(base_lr: float, num_steps: int, *,
                    schedule: str = "warmup_linear",
                    warmup_fraction: float = 0.1,
                    weight_decay: float = 0.01,
-                   grad_clip_norm: float = 1.0) -> optax.GradientTransformation:
-    """adamw under the named schedule — the default for the BERT/GPT loops
-    (constant LR remains available as ``schedule="constant"``).
+                   grad_clip_norm: float = 1.0,
+                   optimizer: str = "adamw") -> optax.GradientTransformation:
+    """The transformer-family optimizer under the named schedule — the
+    default for the BERT/GPT loops (constant LR remains available as
+    ``schedule="constant"``).
+
+    ``optimizer``: "adamw" (default) or "lamb" — LAMB layer-wise trust
+    ratios (You et al. 2019) are the standard recipe once data-parallel
+    scale-out pushes the global batch past ~1k sequences, where adamw's
+    single LR stops fitting every layer.
 
     ``grad_clip_norm``: global-norm gradient clipping applied before the
-    adamw update (the canonical BERT/GPT recipe clips at 1.0 — it is what
+    update (the canonical BERT/GPT recipe clips at 1.0 — it is what
     lets warmup survive the early loss-spike regime); 0 disables."""
     warmup = max(1, int(warmup_fraction * num_steps))
     if schedule == "constant":
@@ -137,7 +144,12 @@ def transformer_tx(base_lr: float, num_steps: int, *,
         lr = warmup_cosine(base_lr, warmup, num_steps)
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
-    adamw = optax.adamw(lr, weight_decay=weight_decay)
+    if optimizer == "adamw":
+        tx = optax.adamw(lr, weight_decay=weight_decay)
+    elif optimizer == "lamb":
+        tx = optax.lamb(lr, weight_decay=weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
     if grad_clip_norm and grad_clip_norm > 0:
-        return optax.chain(optax.clip_by_global_norm(grad_clip_norm), adamw)
-    return adamw
+        return optax.chain(optax.clip_by_global_norm(grad_clip_norm), tx)
+    return tx

@@ -1,0 +1,75 @@
+"""Analytic per-step FLOP accounting for the benchmark families.
+
+Used by bench.py to report model FLOPS utilization (MFU) next to every
+throughput number — raw flops are recorded too, so any peak can re-derive
+the percentage.  Analytic (not compiler-reported) on purpose: a second
+``lower().compile()`` on the tunneled device costs minutes, and XLA's
+cost model counts implementation flops (rematerialization, fused
+epilogues), while MFU is defined against MODEL flops — the work the math
+requires, not the work the compiler chose to do.
+
+Formulas (standard accounting, e.g. the PaLM appendix convention):
+- a dense matmul with N parameters costs ``2·N`` flops per token forward,
+  ``6·N`` forward+backward (backward does two matmuls per forward one);
+- attention scores + weighted values cost ``4·B·S²·E`` forward per layer
+  (2 for QKᵀ, 2 for AV), ``12·B·S²·E`` with backward;
+- the embedding gather is free; the TIED vocab decoder is a real matmul
+  and is counted at the positions that reach the head (the packed
+  capacity for the MLM families, every position for the causal family).
+"""
+
+from __future__ import annotations
+
+# TPU v5e (the measurement chip): 197 TFLOP/s bf16 peak per chip.
+PEAK_TFLOPS = {"bf16": 197.0, "fp32": 49.0}
+
+# fwd-only GFLOPs per image at the bench input geometry (canonical
+# published MACs x 2).  fwd+bwd = 3x.
+_IMAGE_FWD_GFLOPS = {
+    "resnet50": 8.2,      # 4.09 GMAC @ 224x224
+    "resnet20": 0.082,    # 41 MMAC @ 32x32
+    "mnist_cnn": 0.024,   # 2 convs + fc on 28x28 (computed from geometry)
+}
+
+
+def transformer_train_flops(cfg, batch: int, seq_len: int,
+                            head_positions: int | None = None) -> float:
+    """Model flops for ONE fwd+bwd train step of the shared transformer
+    stack (models/bert.py geometry).  ``head_positions``: tokens reaching
+    the MLM head per sequence (packed capacity; default = the model's
+    ce_capacity rule for the MLM families, S for causal)."""
+    E, L, M, V = cfg.hidden, cfg.layers, cfg.mlp, cfg.vocab_size
+    B, S = batch, seq_len
+    # per-layer matmul params: QKV + out proj (4·E²) + MLP (2·E·M)
+    layer_mm = 4 * E * E + 2 * E * M
+    enc = 6 * B * S * L * layer_mm          # encoder matmuls, fwd+bwd
+    attn = 12 * L * B * S * S * E           # scores + AV, fwd+bwd
+    if head_positions is None:
+        if getattr(cfg, "ce_positions", "all") == "masked":
+            from mpi_tensorflow_tpu.models.bert import ce_capacity
+
+            head_positions = ce_capacity(cfg, S)
+        else:
+            head_positions = S
+    P = B * head_positions
+    head = 6 * P * (E * E + V * E)          # transform + tied decoder
+    return float(enc + attn + head)
+
+
+def image_train_flops(model_name: str, batch: int) -> float | None:
+    """Model flops for one fwd+bwd step of an image family, or None when
+    the model has no canonical number recorded."""
+    g = _IMAGE_FWD_GFLOPS.get(model_name)
+    if g is None:
+        return None
+    return 3.0 * g * 1e9 * batch
+
+
+def mfu_pct(flops_per_step: float | None, step_seconds: float,
+            precision: str) -> float | None:
+    """Achieved model-flops rate as % of the chip's peak for ``precision``
+    ("bf16" | "fp32"); None when flops or peak are unknown."""
+    peak = PEAK_TFLOPS.get(precision)
+    if not flops_per_step or not peak or step_seconds <= 0:
+        return None
+    return 100.0 * flops_per_step / step_seconds / (peak * 1e12)

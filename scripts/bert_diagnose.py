@@ -99,15 +99,14 @@ def main():
                             state0)
 
     multi0 = gspmd.make_gspmd_multi_step(model, mesh, tx)
-    for K in (1, 4, 16, 32):
+    # two points determine the dispatch(K) = K*step + C line; each extra K
+    # is another ~2min remote compile and the 1500s budget timed out once
+    for K in (1, 32):
         batches, labels = make_inputs(K)
         sec = median_dispatch(multi0, fresh(), batches, labels,
                               jax.random.key(1), thread_state=True)
         emit(f"full_scan{K}", sec / K, {"dispatch_ms": round(sec * 1e3, 2),
                                         "K": K})
-
-    # linear fit: step time and per-dispatch constant
-    # (re-measure K=4 and K=32 for the fit inputs above if noisy)
 
     # 2. no-dropout ablation
     model_nd, mesh, tx, state = build(dropout=0.0)
@@ -117,30 +116,17 @@ def main():
                           thread_state=True)
     emit("no_dropout_scan16", sec / 16)
 
-    # 3. XLA attention ablation
+    # 3. XLA attention ablation (the shipping default since flash_min_seq;
+    # build() forces flash_min_seq=0, so use_flash=True is the flash arm)
     model_x, mesh, tx, state = build(use_flash=False)
     multi = gspmd.make_gspmd_multi_step(model_x, mesh, tx)
     sec = median_dispatch(multi, state, batches, labels, jax.random.key(1),
                           thread_state=True)
     emit("xla_attn_scan16", sec / 16)
 
-    # 3b. fused-QKV candidate (one (E,3HD) matmul per layer)
-    model_fq, mesh, tx, state = build(fused_qkv=True)
-    multi = gspmd.make_gspmd_multi_step(model_fq, mesh, tx)
-    sec = median_dispatch(multi, state, batches, labels, jax.random.key(1),
-                          thread_state=True)
-    emit("fused_qkv_scan16", sec / 16)
-
-    # 3c. rbg PRNG candidate (cheaper dropout mask generation than
-    # threefry) — the key's impl propagates through fold_in/bernoulli
-    rbg_key = jax.random.key(1, impl="rbg")
-    try:
-        sec = median_dispatch(multi0, fresh(), batches, labels, rbg_key,
-                              thread_state=True)
-        emit("rbg_prng_scan16", sec / 16)
-    except Exception as e:
-        print(json.dumps({"ablation": "rbg_prng_scan16",
-                          "error": str(e)[:200]}), flush=True)
+    # (fused-QKV and rbg-PRNG candidates moved to BENCH-grade queue arms
+    # bert_fused_qkv / bert_rbg — each ablation here costs a ~2min remote
+    # compile and the 1500s window budget timed out once)
 
     # 4. forward-only loss (scan to amortize) — pristine state0 params
     params0 = state0.params

@@ -30,22 +30,12 @@ LOG = os.path.join(REPO, "MEASURE_LOG.jsonl")
 STAMPS = os.path.join(REPO, ".tpu_done")
 
 
-def _json_safe(obj):
-    """NaN/Inf -> None, recursively: bare json.dumps writes literal
-    ``NaN`` tokens that strict consumers (jq, JSON.parse) abort on — the
-    repo convention (utils/metrics_writer.py)."""
-    if isinstance(obj, float) and (obj != obj or obj in
-                                   (float("inf"), float("-inf"))):
-        return None
-    if isinstance(obj, dict):
-        return {k: _json_safe(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_json_safe(v) for v in obj]
-    return obj
+from mpi_tensorflow_tpu.utils.jsonsafe import json_safe  # noqa: E402
 
 
 def emit(obj):
-    line = json.dumps(_json_safe(obj))
+    # json_safe: NaN/Inf -> null, the repo's JSON-strictness rule
+    line = json.dumps(json_safe(obj))
     print(line, flush=True)
     with open(LOG, "a") as f:
         f.write(line + "\n")
@@ -77,13 +67,13 @@ def _sub_env():
     return env
 
 
-def run_script(script, tail=4000, extra=()):
+def run_script(script, tail=4000, extra=(), timeout=1500):
     """Run a scripts/ diagnostic in a subprocess; RAISE on a non-zero
     exit so run_item does not stamp — a failed diagnostic must retry
     next window, like every other item."""
     r = subprocess.run([sys.executable, os.path.join("scripts", script),
                         *extra],
-                       capture_output=True, text=True, timeout=1500,
+                       capture_output=True, text=True, timeout=timeout,
                        env=_sub_env())
     if r.returncode != 0:
         raise RuntimeError(f"{script} rc={r.returncode}: "
@@ -114,7 +104,9 @@ def main():
     # the diagnose/profile scripts import-and-init their own client; they
     # still run as subprocesses (their cost_analysis/profiler state should
     # not leak into the bench numbers) but FIRST in the window
-    run_item("bert_diagnose", lambda: run_script("bert_diagnose.py", 4000))
+    # ~8 remote compiles at ~2min each: 1500s timed out mid-run once
+    run_item("bert_diagnose", lambda: run_script("bert_diagnose.py", 4000,
+                                                 timeout=2700))
     run_item("bert_profile", lambda: run_script("bert_profile.py", 6000))
     run_item("resnet_profile", lambda: run_script(
         "bert_profile.py", 6000, extra=("--model", "resnet50")))

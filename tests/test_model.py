@@ -170,6 +170,48 @@ class TestOptimizer:
             assert isinstance(tx, optax.GradientTransformation)
         with pytest.raises(ValueError, match="unknown schedule"):
             optimizer.transformer_tx(1e-3, 100, schedule="nope")
+        with pytest.raises(ValueError, match="unknown optimizer"):
+            optimizer.transformer_tx(1e-3, 100, optimizer="sgd")
+
+    def test_lamb_trust_ratio_scales_update_to_param_norm(self):
+        """LAMB's defining property (You et al. 2019): the raw adam-style
+        update is rescaled by |param| / |update| per layer, so two layers
+        with identical gradients but different weight norms get updates
+        proportional to their own norms — adamw would update both
+        identically."""
+        import jax.numpy as jnp
+
+        params = {"small": jnp.full((4,), 0.1), "big": jnp.full((4,), 10.0)}
+        grads = {"small": jnp.full((4,), 0.5), "big": jnp.full((4,), 0.5)}
+        tx = optimizer.transformer_tx(1e-2, 10, schedule="constant",
+                                      optimizer="lamb", weight_decay=0.0,
+                                      grad_clip_norm=0.0)
+        upd, _ = tx.update(grads, tx.init(params), params)
+        ratio = float(jnp.linalg.norm(upd["big"])
+                      / jnp.linalg.norm(upd["small"]))
+        assert ratio == pytest.approx(100.0, rel=1e-3)   # 10.0 / 0.1
+
+    def test_lamb_trains_tiny_mlm(self):
+        """--optimizer lamb end-to-end through the transformer loop."""
+        import dataclasses as dc
+
+        import numpy as np
+
+        from mpi_tensorflow_tpu.config import Config
+        from mpi_tensorflow_tpu.models import bert
+        from mpi_tensorflow_tpu.train import mlm_loop
+
+        cfg = Config(epochs=1, batch_size=4, model="bert_base",
+                     optimizer="lamb", log_every=2)
+        res = mlm_loop.train_mlm(cfg, bert_cfg=bert.BERT_TINY, seq_len=32,
+                                 train_n=64, test_n=16, verbose=False)
+        assert np.isfinite(res.final_error)
+
+    def test_cli_threads_optimizer(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(["--optimizer", "lamb"])
+        assert cli.config_from_args(args).optimizer == "lamb"
 
     def test_transformer_tx_clips_global_norm(self):
         import jax
