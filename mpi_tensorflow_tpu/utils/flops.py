@@ -66,10 +66,15 @@ def image_train_flops(model_name: str, batch: int) -> float | None:
 
 
 def mfu_pct(flops_per_step: float | None, step_seconds: float,
-            precision: str) -> float | None:
+            precision: str, platform: str = "tpu") -> float | None:
     """Achieved model-flops rate as % of the chip's peak for ``precision``
-    ("bf16" | "fp32"); None when flops or peak are unknown."""
+    ("bf16" | "fp32").  None when flops or peak are unknown — including
+    any ``platform`` other than "tpu": the peak table is the v5e
+    measurement chip's, and reporting a confident percentage against it
+    from a CPU run would be exactly the quietly-wrong claim this module
+    exists to prevent."""
     peak = PEAK_TFLOPS.get(precision)
-    if not flops_per_step or not peak or step_seconds <= 0:
+    if platform != "tpu" or not flops_per_step or not peak \
+            or step_seconds <= 0:
         return None
     return 100.0 * flops_per_step / step_seconds / (peak * 1e12)

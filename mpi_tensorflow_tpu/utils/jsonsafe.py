@@ -2,10 +2,10 @@
 
 NaN/Inf are not JSON: ``json.dumps`` happily writes literal ``NaN`` /
 ``Infinity`` tokens (``allow_nan`` defaults True) and strict consumers
-(jq, ``JSON.parse``) abort the whole stream on one bad line.  Every
-artifact writer (bench.py output, the measurement queue's
-MEASURE_LOG.jsonl, utils/metrics_writer.py) routes through this rule so
-the implementations cannot drift.
+(jq, ``JSON.parse``) abort the whole stream on one bad line.  bench.py's
+output lines and the measurement queue's MEASURE_LOG.jsonl route through
+``json_safe``; utils/metrics_writer.py applies the same rule inline at
+its single scalar() write site (a scalar check, not a tree walk).
 """
 
 from __future__ import annotations

@@ -90,7 +90,11 @@ def emit(name, sec_per_step, extra=None):
 def main():
     # 1. scan-window sweep on the full step: separates device step time
     #    from per-dispatch (tunnel RTT) overhead.  dispatch(K) = K*step + C
+    # (each emit doubles as a progress marker: on a timeout the queue
+    # records partial stdout, naming the last completed stage)
+    print(json.dumps({"stage": "client_init"}), flush=True)
     model, mesh, tx, state0 = build()
+    print(json.dumps({"stage": "built"}), flush=True)
 
     def fresh():
         """Deep on-device copy — donated timings consume the copy, the

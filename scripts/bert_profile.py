@@ -77,7 +77,11 @@ def main():
     model_name = "bert_base"
     if "--model" in sys.argv:
         model_name = sys.argv[sys.argv.index("--model") + 1]
+    # stage prints flush immediately: on a timeout the queue's run_script
+    # records the partial stdout, so the log names the stage that hung
+    print(json.dumps({"stage": "client_init"}), flush=True)
     mesh = meshlib.make_mesh()
+    print(json.dumps({"stage": "build", "model": model_name}), flush=True)
     if model_name == "resnet50":
         multi, state, batches, labels = build_resnet50(mesh)
         K = batches.shape[0]
@@ -85,14 +89,17 @@ def main():
         multi, state, batches, labels = build_bert(mesh)
 
     # warmup/compile
+    print(json.dumps({"stage": "compile"}), flush=True)
     st, m = multi(state, batches, labels, jax.random.key(1))
     float(m["loss"][-1])
+    print(json.dumps({"stage": "trace"}), flush=True)
 
     logdir = tempfile.mkdtemp(prefix="bertprof_")
     jax.profiler.start_trace(logdir)
     st, m = multi(st, batches, labels, jax.random.key(1))
     float(m["loss"][-1])
     jax.profiler.stop_trace()
+    print(json.dumps({"stage": "convert"}), flush=True)
 
     xplanes = glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
                         recursive=True)

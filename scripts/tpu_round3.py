@@ -9,8 +9,11 @@ most of the window.  This driver runs the WHOLE queue on one client:
   (a tunnel drop mid-queue loses only the in-flight item);
 - completed items stamp .tpu_done/<name> and are skipped on re-run, so
   scripts/tpu_watch.sh can fire this repeatedly across windows;
-- items are ordered by information value: the stall diagnosis first,
-  then the ResNet target sweep, then family coverage.
+- cheap in-process BENCH arms run first (each lands a decisive number in
+  minutes on the shared client); the subprocess diagnostics (ablation
+  sweep, xprof profiles) run LAST — each pays its own client init and up
+  to 45min, and two windows were spent entirely on their timeouts when
+  they led the queue.
 """
 
 from __future__ import annotations
@@ -70,11 +73,22 @@ def _sub_env():
 def run_script(script, tail=4000, extra=(), timeout=1500):
     """Run a scripts/ diagnostic in a subprocess; RAISE on a non-zero
     exit so run_item does not stamp — a failed diagnostic must retry
-    next window, like every other item."""
-    r = subprocess.run([sys.executable, os.path.join("scripts", script),
-                        *extra],
-                       capture_output=True, text=True, timeout=timeout,
-                       env=_sub_env())
+    next window, like every other item.  A timeout re-raises WITH the
+    partial stdout, so the log names the stage the script hung at (the
+    scripts print a progress line per stage)."""
+    try:
+        r = subprocess.run([sys.executable,
+                            os.path.join("scripts", script), *extra],
+                           capture_output=True, text=True, timeout=timeout,
+                           env=_sub_env())
+    except subprocess.TimeoutExpired as e:
+        def _txt(b):
+            if b is None:
+                return ""
+            return b.decode(errors="replace") if isinstance(b, bytes) else b
+        raise RuntimeError(
+            f"{script} timed out after {timeout}s; partial stdout: "
+            f"{_txt(e.stdout)[-800:]!r} stderr: {_txt(e.stderr)[-400:]!r}")
     if r.returncode != 0:
         raise RuntimeError(f"{script} rc={r.returncode}: "
                            f"{r.stderr[-600:]}")
@@ -100,22 +114,14 @@ def main():
     os.chdir(REPO)
     import bench
 
-    # -- 1. stall diagnosis: ablations share the client; each is scan=16
-    # the diagnose/profile scripts import-and-init their own client; they
-    # still run as subprocesses (their cost_analysis/profiler state should
-    # not leak into the bench numbers) but FIRST in the window
-    # ~8 remote compiles at ~2min each: 1500s timed out mid-run once
-    run_item("bert_diagnose", lambda: run_script("bert_diagnose.py", 4000,
-                                                 timeout=2700))
-    run_item("bert_profile", lambda: run_script("bert_profile.py", 6000))
-    run_item("resnet_profile", lambda: run_script(
-        "bert_profile.py", 6000, extra=("--model", "resnet50")))
-
-    # -- 2. in-process queue: one client init for everything below
-    # flagship candidate arms first: if the diagnosis names dropout-PRNG
-    # or QKV-dispatch cost as the stall, these are the BENCH-grade numbers
-    # for the fix (rbg = cheap RngBitGenerator masks; fused = one (E,3HD)
-    # matmul per layer); b128/b256 probe the MFU-vs-batch ceiling
+    # -- 1. in-process queue first: one client init, each arm lands a
+    # decisive number in minutes.  The subprocess diagnostics (diagnose /
+    # xprof profiles) moved to the END of the queue: each costs its own
+    # client init and up to 45min, and two windows were spent entirely on
+    # their timeouts before any BENCH arm ran.
+    # Flagship candidate arms (rbg = cheap RngBitGenerator masks; fused =
+    # one (E,3HD) matmul per layer); b128/b256 probe the MFU-vs-batch
+    # ceiling
     run_item("bert_rbg", lambda: bench.measure_bert(
         batch_size=64, steps=32, precision="bf16", scan_steps=4,
         prng_impl="rbg"))
@@ -208,6 +214,17 @@ def main():
     run_item("bert_s2048_noflash", lambda: noflash(
         ("--seq-len", "2048", "--batch-size", "4", "--scan-steps", "2",
          "--steps", "8", "--remat")))
+
+    # -- 2. subprocess diagnostics LAST: exploratory, expensive (own
+    # client init each; remote compiles ~2min apiece), and a timeout here
+    # no longer starves the BENCH arms above
+    run_item("bert_diagnose", lambda: run_script("bert_diagnose.py", 4000,
+                                                 timeout=2700))
+    run_item("bert_profile", lambda: run_script("bert_profile.py", 6000,
+                                                timeout=2700))
+    run_item("resnet_profile", lambda: run_script(
+        "bert_profile.py", 6000, extra=("--model", "resnet50"),
+        timeout=2700))
     print("queue complete", flush=True)
 
 

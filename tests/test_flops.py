@@ -55,13 +55,16 @@ def test_mfu_pct():
     assert fl.mfu_pct(98.5e12 * 0.1, 0.1, "bf16") == pytest.approx(50.0)
     assert fl.mfu_pct(None, 0.1, "bf16") is None
     assert fl.mfu_pct(1e12, 0.1, "int8") is None   # unknown peak
+    # the peak table is the v5e's — a CPU run must not claim an MFU
+    assert fl.mfu_pct(1e12, 0.1, "bf16", platform="cpu") is None
 
 
-def test_bench_detail_carries_mfu(monkeypatch):
+def test_bench_detail_carries_flops_and_gates_mfu_by_platform(monkeypatch):
     import bench
 
     monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
     r = bench.measure_bert(batch_size=2, steps=2, precision="fp32",
                            scan_steps=1, seq_len=32)
     assert r["model_flops_per_step"] > 0
-    assert r["mfu_pct"] is not None and r["mfu_pct"] > 0
+    # raw flops always recorded; the percentage only against the real chip
+    assert r["mfu_pct"] is None      # tests run on the CPU mesh

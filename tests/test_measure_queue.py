@@ -84,6 +84,21 @@ class TestRunItem:
         # first-window regression: child sys.path[0] is scripts/)
         assert captured["env"]["PYTHONPATH"].startswith(queue_mod.REPO)
 
+    def test_run_script_timeout_carries_partial_stdout(self, queue_mod,
+                                                       monkeypatch):
+        """A timed-out diagnostic must surface the stage markers it
+        printed before hanging — that is how a lost window still names
+        the stall."""
+        import subprocess as sp
+
+        def fake_run(cmd, **kw):
+            raise sp.TimeoutExpired(cmd, kw.get("timeout"),
+                                    output=b'{"stage": "compile"}\n')
+
+        monkeypatch.setattr(queue_mod.subprocess, "run", fake_run)
+        with pytest.raises(RuntimeError, match="compile"):
+            queue_mod.run_script("bert_profile.py", timeout=5)
+
     def test_run_script_success_returns_tails(self, queue_mod, monkeypatch):
         class Ok:
             returncode = 0
