@@ -38,9 +38,19 @@ MODEL_SPECS = {
                      scan=50, steps=500, unit="images"),
     "resnet50": dict(batch=32, shape=(224, 224, 3), classes=1000,
                      scan=8, steps=48, unit="images"),
+    "vit": dict(batch=128, shape=(32, 32, 3), classes=10,
+                scan=20, steps=200, unit="images", dataset="cifar10"),
     "bert_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
     "moe_bert": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
     "gpt_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
+}
+
+# display names for the image-family metric line; tests pin that every
+# image entry in MODEL_SPECS has one (a missing name KeyErrors after the
+# measurement has already run)
+IMAGE_MODEL_NAMES = {
+    "mnist_cnn": "MNIST CNN", "resnet20": "CIFAR ResNet-20",
+    "resnet50": "ImageNet ResNet-50", "vit": "CIFAR ViT-Tiny",
 }
 
 
@@ -200,7 +210,8 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     in_shape = spec["shape"]
     cfg = Config(batch_size=batch_size, precision=precision,
                  model=model_name, num_classes=spec["classes"],
-                 image_size=in_shape[0], remat=remat, prng_impl=prng_impl)
+                 image_size=in_shape[0], remat=remat, prng_impl=prng_impl,
+                 dataset=spec.get("dataset", "mnist"))
     mesh = meshlib.make_mesh()
     ndev = meshlib.data_axis_size(mesh)
     global_b = batch_size * ndev
@@ -247,7 +258,10 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
 
     from mpi_tensorflow_tpu.utils import flops as flops_lib
 
-    step_flops = flops_lib.image_train_flops(model_name, batch_size)
+    if model_name == "vit":
+        step_flops = flops_lib.vit_train_flops(model.cfg, batch_size)
+    else:
+        step_flops = flops_lib.image_train_flops(model_name, batch_size)
     return {
         "model": model_name,
         "images_per_sec": global_b / sec_per_step,
@@ -266,10 +280,13 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
 
 def measure_decode(batch_size: int = 8, prompt_len: int = 32,
                    new_tokens: int = 128, precision: str = "bf16",
-                   iters: int = 5) -> dict:
+                   iters: int = 5, num_beams: int = 0) -> dict:
     """Autoregressive decode throughput: tokens/sec through CausalLm's
     KV-cache ``generate`` (greedy).  The per-token loop is a lax.scan over
-    a static cache, so the whole decode is one compiled dispatch."""
+    a static cache, so the whole decode is one compiled dispatch.
+    ``num_beams > 0`` times ``beam_search`` instead (throughput counted in
+    KEPT tokens/sec, i.e. batch tokens — the K-fold beam work is the price
+    of the search, not output)."""
     import dataclasses as dc
     import time
 
@@ -311,10 +328,16 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
     cache0 = model.init_cache(batch_size, L)
     prefill = jax.jit(
         lambda p, t: model.forward_with_cache(p, t, cache0, 0)[0])
-    gen_short = jax.jit(
-        lambda p, t: model.generate(p, t, n_short, cache_len=L))
-    gen_long = jax.jit(
-        lambda p, t: model.generate(p, t, n_long, cache_len=L))
+    if num_beams > 0:
+        gen_short = jax.jit(lambda p, t: model.beam_search(
+            p, t, n_short, num_beams=num_beams, cache_len=L)[0])
+        gen_long = jax.jit(lambda p, t: model.beam_search(
+            p, t, n_long, num_beams=num_beams, cache_len=L)[0])
+    else:
+        gen_short = jax.jit(
+            lambda p, t: model.generate(p, t, n_short, cache_len=L))
+        gen_long = jax.jit(
+            lambda p, t: model.generate(p, t, n_long, cache_len=L))
     prefill_sec = median_time(lambda: prefill(params, prompt))
     short_sec = median_time(lambda: gen_short(params, prompt))
     long_sec = median_time(lambda: gen_long(params, prompt))
@@ -333,6 +356,7 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
         "batch_size": batch_size,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "num_beams": num_beams,
         "precision": precision,
         "platform": jax.devices()[0].platform,
     }
@@ -458,6 +482,9 @@ def main(argv=None) -> int:
                     help="decode mode: prompt length")
     ap.add_argument("--new-tokens", type=int, default=128,
                     help="decode mode: generated tokens per call")
+    ap.add_argument("--num-beams", type=int, default=0,
+                    help="decode mode: time beam_search at this width "
+                         "instead of greedy generate (0 = greedy)")
     ap.add_argument("--model", choices=list(MODEL_SPECS), default="mnist_cnn",
                     help="which BASELINE config to measure (train mode)")
     ap.add_argument("--scan-steps", type=int, default=None,
@@ -552,10 +579,13 @@ def main(argv=None) -> int:
                            prompt_len=args.prompt_len,
                            new_tokens=args.new_tokens,
                            precision=args.precision,
-                           iters=max(1, (args.steps or 5)))
+                           iters=max(1, (args.steps or 5)),
+                           num_beams=args.num_beams)
         v = r["decode_tokens_per_sec"]
+        kind = (f"beam-{args.num_beams}" if args.num_beams > 0
+                else "greedy")
         _print_json({
-            "metric": "GPT-base greedy decode throughput (KV cache)",
+            "metric": f"GPT-base {kind} decode throughput (KV cache)",
             "value": round(v, 1) if v == v else None,   # NaN -> null
             "unit": "tokens/sec",
             "vs_baseline": None,
@@ -658,10 +688,8 @@ def main(argv=None) -> int:
             vs = (result["images_per_sec_per_chip"]
                   / base["images_per_sec_per_chip"])
 
-    names = {"mnist_cnn": "MNIST CNN", "resnet20": "CIFAR ResNet-20",
-             "resnet50": "ImageNet ResNet-50"}
     _print_json({
-        "metric": f"{names[args.model]} train-step throughput "
+        "metric": f"{IMAGE_MODEL_NAMES[args.model]} train-step throughput "
                   "(eval off timed path)",
         "value": round(result["images_per_sec_per_chip"], 1),
         "unit": "images/sec/chip",

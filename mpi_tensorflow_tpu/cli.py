@@ -50,8 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--seed", type=int, default=d.seed)
     p.add_argument("--data-dir", default=d.data_dir)
     p.add_argument("--model", default=d.model,
-                   choices=["mnist_cnn", "resnet20", "resnet50", "bert_base",
-                            "moe_bert", "gpt_base"])
+                   choices=["mnist_cnn", "resnet20", "resnet50", "vit",
+                            "bert_base", "moe_bert", "gpt_base"])
     p.add_argument("--dataset", default=d.dataset,
                    choices=["mnist", "cifar10", "imagenet_synthetic",
                             "mlm_synthetic"])

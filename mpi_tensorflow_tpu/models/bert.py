@@ -101,6 +101,31 @@ def _norm_init(key, shape, stddev=0.02):
     return jax.random.normal(key, shape) * stddev
 
 
+def init_encoder_layer(k, c) -> dict:
+    """One encoder layer's parameters (``k``: a key iterator, 6 keys
+    consumed).  Shared by BertMlm.init and the ViT family so the layer
+    pytree structure — which ``_run_layers``, the pipeline stages, and
+    the sharding rules all assume — has exactly one definition."""
+    return {
+        "wq": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
+        "wk": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
+        "wv": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
+        "bq": jnp.zeros((c.heads, c.head_dim)),
+        "bk": jnp.zeros((c.heads, c.head_dim)),
+        "bv": jnp.zeros((c.heads, c.head_dim)),
+        "wo": _norm_init(next(k), (c.heads, c.head_dim, c.hidden)),
+        "bo": jnp.zeros((c.hidden,)),
+        "ln1": {"scale": jnp.ones((c.hidden,)),
+                "bias": jnp.zeros((c.hidden,))},
+        "w1": _norm_init(next(k), (c.hidden, c.mlp)),
+        "b1": jnp.zeros((c.mlp,)),
+        "w2": _norm_init(next(k), (c.mlp, c.hidden)),
+        "b2": jnp.zeros((c.hidden,)),
+        "ln2": {"scale": jnp.ones((c.hidden,)),
+                "bias": jnp.zeros((c.hidden,))},
+    }
+
+
 def ce_capacity(cfg, S: int) -> int:
     """Packed-buffer width for the masked-position head: per-row capacity
     ``ce_capacity_frac * S`` rounded up to a multiple of 8 (lane-friendly),
@@ -208,24 +233,7 @@ class BertMlm:
             },
         }
         for _ in range(c.layers):
-            params["layers"].append({
-                "wq": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
-                "wk": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
-                "wv": _norm_init(next(k), (c.hidden, c.heads, c.head_dim)),
-                "bq": jnp.zeros((c.heads, c.head_dim)),
-                "bk": jnp.zeros((c.heads, c.head_dim)),
-                "bv": jnp.zeros((c.heads, c.head_dim)),
-                "wo": _norm_init(next(k), (c.heads, c.head_dim, c.hidden)),
-                "bo": jnp.zeros((c.hidden,)),
-                "ln1": {"scale": jnp.ones((c.hidden,)),
-                        "bias": jnp.zeros((c.hidden,))},
-                "w1": _norm_init(next(k), (c.hidden, c.mlp)),
-                "b1": jnp.zeros((c.mlp,)),
-                "w2": _norm_init(next(k), (c.mlp, c.hidden)),
-                "b2": jnp.zeros((c.hidden,)),
-                "ln2": {"scale": jnp.ones((c.hidden,)),
-                        "bias": jnp.zeros((c.hidden,))},
-            })
+            params["layers"].append(init_encoder_layer(k, c))
         return params
 
     def logical_axes(self):
@@ -342,12 +350,32 @@ class BertMlm:
 
     def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
         """Encoder returning ``(hidden, summed aux loss)``."""
+        c = self.cfg
+        B, S = tokens.shape
+        h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
+        h = _layernorm(h, params["emb_ln"])
+        if train and c.dropout > 0.0:
+            if rng is None:
+                raise ValueError("dropout needs an rng in train mode")
+            h = dropout_mask(h, c.dropout, jax.random.fold_in(rng, 1))
+        h = h.astype(c.dtype)
+        h = self._constrain(h, ("batch", "seq", "embed"))
+        # layer dropout streams continue from index 1 (the embedding site)
+        return self._run_layers(params, h, train=train, rng=rng,
+                                drop_start=1)
+
+    def _run_layers(self, params, h, *, train: bool = False, rng=None,
+                    drop_start: int = 0):
+        """The encoder layer stack on an already-embedded ``h`` (B, S, E)
+        in the compute dtype.  Shared by the token path above and the
+        ViT patch path (models/vit.py).  ``drop_start``: first unused
+        dropout stream index — layer sites fold rng on drop_start+1, ...
+        (stable across a remat recomputation)."""
         import functools
 
         c = self.cfg
         dt = c.dtype
-        B, S = tokens.shape
-        drop_i = 0
+        drop_i = drop_start
 
         def drop_with(i, x):
             """Dropout keyed by an explicit stream index (stable across a
@@ -357,16 +385,6 @@ class BertMlm:
             if rng is None:
                 raise ValueError("dropout needs an rng in train mode")
             return dropout_mask(x, c.dropout, jax.random.fold_in(rng, i))
-
-        def dropout(x):
-            nonlocal drop_i
-            drop_i += 1
-            return drop_with(drop_i, x)
-
-        h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
-        h = _layernorm(h, params["emb_ln"])
-        h = dropout(h).astype(dt)
-        h = self._constrain(h, ("batch", "seq", "embed"))
 
         def layer(h, lp, keys, mlp_fn):
             # --- attention (column-parallel QKV, row-parallel out) ---

@@ -200,6 +200,98 @@ class CausalLm(bert_lib.BertMlm):
             if max_new_tokens > 1 else first[:, None]
         return jnp.concatenate([prompt, out], axis=1)
 
+    def beam_search(self, params, prompt, max_new_tokens: int, *,
+                    num_beams: int = 4, length_penalty: float = 0.0,
+                    cache_len: int | None = None):
+        """Fixed-length beam search over the KV-cache decode path.
+
+        ``prompt``: (B, S0) int ids.  Returns ``(sequences, scores)``:
+        sequences (B, num_beams, S0 + max_new_tokens) sorted by score
+        descending, scores (B, num_beams) = sum of chosen-token log-probs
+        divided by ``(new_tokens) ** length_penalty`` (0 = pure sum, the
+        default; >0 favors longer... equal-length here, so it only
+        rescales uniformly — exposed for API parity with samplers).
+
+        TPU-shaped like ``generate``: beams fold into the batch dimension
+        for the forward pass ((B*beam, 1) tokens per step), the per-step
+        beam reindex is a ``take_along_axis`` gather over a (B, beam, ...)
+        view of every cache leaf, and the whole loop is one ``lax.scan``
+        — static shapes, one compilation.  No EOS semantics: the LM
+        families train on streams without a terminator token, so beams
+        always extend to the full length.
+
+        ``cache_len`` pins the KV-cache capacity, exactly as in
+        ``generate`` (decode cost scales with capacity, not occupancy —
+        timing arms at different lengths must share one capacity)."""
+        if max_new_tokens < 1:
+            raise ValueError("beam_search needs max_new_tokens >= 1")
+        if num_beams < 1:
+            raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+        B, S0 = prompt.shape
+        K = num_beams
+        total = S0 + max_new_tokens
+        if cache_len is not None and cache_len < total:
+            raise ValueError(f"cache_len {cache_len} < prompt + "
+                             f"max_new_tokens ({total})")
+        V = self.cfg.vocab_size
+
+        # prefill once at batch B, then tile the cache K-fold
+        cache = self.init_cache(B, cache_len or total)
+        logits, cache = self.forward_with_cache(params, prompt, cache, 0)
+        logp0 = jax.nn.log_softmax(logits[:, -1], axis=-1)      # (B, V)
+        scores, first = lax.top_k(logp0, K)                     # (B, K)
+        cache = jax.tree.map(
+            lambda c: jnp.repeat(c, K, axis=0), cache)          # (B*K, ...)
+
+        def step(carry, i):
+            cache, scores, token = carry                # token: (B, K)
+            logits, cache = self.forward_with_cache(
+                params, token.reshape(B * K, 1), cache, S0 + i)
+            logp = jax.nn.log_softmax(
+                logits[:, 0].reshape(B, K, V), axis=-1)
+            cand = scores[..., None] + logp             # (B, K, V)
+            scores, flat = lax.top_k(cand.reshape(B, K * V), K)
+            parent = flat // V                          # which beam (B, K)
+            nxt = (flat % V).astype(jnp.int32)
+            # reindex every cache leaf to the surviving beams
+            def reindex(c):
+                v = c.reshape(B, K, *c.shape[1:])
+                idx = parent.reshape(B, K, *([1] * (v.ndim - 2)))
+                return jnp.take_along_axis(v, idx, axis=1) \
+                    .reshape(B * K, *c.shape[1:])
+            cache = jax.tree.map(reindex, cache)
+            return (cache, scores, nxt), (parent, nxt)
+
+        if max_new_tokens > 1:
+            (_, scores, _), (parents, toks) = lax.scan(
+                step, (cache, scores, first),
+                jnp.arange(max_new_tokens - 1))
+            # backtrack: follow parent pointers from the final beam slots.
+            # At reverse position t the carry indexes step-(t+1) slots:
+            # the token emitted there is toks[t][slot], and the chain
+            # continues at parents[t][slot] (a step-t slot).
+            def backtrack(beam_idx, xs):
+                parent, tok = xs                         # (B, K) each
+                cur_tok = jnp.take_along_axis(tok, beam_idx, 1)
+                prev_idx = jnp.take_along_axis(parent, beam_idx, 1)
+                return prev_idx, cur_tok
+
+            beam_idx0 = jnp.tile(jnp.arange(K)[None], (B, 1))
+            final_idx, rev = lax.scan(
+                backtrack, beam_idx0, (parents, toks), reverse=True)
+            # reverse=True stacks ys at their forward indices: rev[t] is
+            # the token at generated position t+1 on each final beam
+            mid = jnp.moveaxis(rev, 0, -1)               # (B, K, T-1)
+            root = jnp.take_along_axis(first, final_idx, 1)  # (B, K)
+            out = jnp.concatenate([root[..., None], mid], axis=-1)
+        else:
+            out = first[..., None]                       # (B, K, 1)
+        seqs = jnp.concatenate(
+            [jnp.broadcast_to(prompt[:, None], (B, K, S0)), out], axis=-1)
+        if length_penalty:
+            scores = scores / (float(max_new_tokens) ** length_penalty)
+        return seqs, scores
+
     def _sample(self, logits, temperature, rng, i, *, top_k: int = 0,
                 top_p: float = 1.0):
         """(B, V) fp32 logits -> (B,) token ids.

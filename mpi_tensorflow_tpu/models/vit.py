@@ -1,0 +1,143 @@
+"""Vision Transformer — the transformer stack applied to the image task.
+
+The reference's only model is a 2-conv CNN (mpipy.py:38-53); the scale-out
+families added ResNets (conv) and BERT/GPT/MoE (token transformers).  ViT
+closes the loop between the two stacks: the image families' data pipeline,
+train step, and loop drive the SAME encoder layers as BERT
+(`bert._run_layers` / `bert.init_encoder_layer` — one definition, so a
+layer change can never diverge the families), with patch embedding in
+place of token embedding and a CLS-token classification head in place of
+the MLM head.
+
+TPU shape notes: patch extraction is a reshape/transpose + one (N, P²C)
+x (P²C, E) matmul — no gathers; the sequence length is static
+(N = (H/P)(W/P) + 1 CLS), so the whole step jits once.  The encoder
+inherits every BertConfig lever (remat, fused_qkv, flash_min_seq — the
+latter moot at ViT's short N, where XLA dense attention is the measured
+winner anyway).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from mpi_tensorflow_tpu.models import bert as bert_lib
+
+
+@dataclasses.dataclass(frozen=True)
+class VitConfig:
+    image_size: int = 32
+    patch: int = 4
+    channels: int = 3
+    num_classes: int = 10
+    hidden: int = 192         # ViT-Tiny geometry for CIFAR by default
+    layers: int = 12
+    heads: int = 3
+    mlp: int = 768
+    dropout: float = 0.1
+    dtype: Any = jnp.float32
+    remat: bool = False
+
+    @property
+    def num_patches(self) -> int:
+        if self.image_size % self.patch:
+            raise ValueError(f"image_size {self.image_size} not divisible "
+                             f"by patch {self.patch}")
+        return (self.image_size // self.patch) ** 2
+
+
+VIT_TINY_CIFAR = VitConfig()
+VIT_S16_IMAGENET = VitConfig(image_size=224, patch=16, num_classes=1000,
+                             hidden=384, layers=12, heads=6, mlp=1536)
+
+
+@dataclasses.dataclass(frozen=True)
+class VisionTransformer:
+    cfg: VitConfig = VIT_TINY_CIFAR
+
+    @property
+    def num_classes(self) -> int:
+        return self.cfg.num_classes
+
+    def _bert_cfg(self) -> bert_lib.BertConfig:
+        c = self.cfg
+        return dataclasses.replace(
+            bert_lib.BERT_TINY, hidden=c.hidden, layers=c.layers,
+            heads=c.heads, mlp=c.mlp, dropout=c.dropout, dtype=c.dtype,
+            remat=c.remat, max_positions=c.num_patches + 1)
+
+    def _encoder(self) -> bert_lib.BertMlm:
+        """The shared encoder stack, configured for this ViT (no mesh:
+        the image loop is the DP path; use_flash is irrelevant at ViT's
+        short sequence — flash_min_seq keeps XLA attention)."""
+        return bert_lib.BertMlm(self._bert_cfg())
+
+    # ---------------- init ----------------
+
+    def init(self, rng):
+        c = self.cfg
+        bcfg = self._bert_cfg()
+        k = iter(jax.random.split(rng, 8 + 6 * c.layers))
+        pdim = c.patch * c.patch * c.channels
+        params = {
+            "patch_w": bert_lib._norm_init(next(k), (pdim, c.hidden)),
+            "patch_b": jnp.zeros((c.hidden,)),
+            "cls": bert_lib._norm_init(next(k), (1, 1, c.hidden)),
+            "pos_emb": bert_lib._norm_init(
+                next(k), (c.num_patches + 1, c.hidden)),
+            "emb_ln": {"scale": jnp.ones((c.hidden,)),
+                       "bias": jnp.zeros((c.hidden,))},
+            "layers": [bert_lib.init_encoder_layer(k, bcfg)
+                       for _ in range(c.layers)],
+            "head_ln": {"scale": jnp.ones((c.hidden,)),
+                        "bias": jnp.zeros((c.hidden,))},
+            "head_w": bert_lib._norm_init(next(k),
+                                          (c.hidden, c.num_classes)),
+            "head_b": jnp.zeros((c.num_classes,)),
+        }
+        return params
+
+    # ---------------- forward ----------------
+
+    def _patchify(self, images):
+        """(B, H, W, C) -> (B, N, P*P*C) by pure reshape/transpose."""
+        c = self.cfg
+        B, H, W, C = images.shape
+        g = H // c.patch
+        x = images.reshape(B, g, c.patch, g, c.patch, C)
+        return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+            B, g * g, c.patch * c.patch * C)
+
+    def apply(self, params, images, *, train: bool = False, rng=None):
+        """(B, H, W, C) float images -> (B, num_classes) fp32 logits."""
+        c = self.cfg
+        dt = c.dtype
+        x = self._patchify(images.astype(dt))
+        h = x @ params["patch_w"].astype(dt) + params["patch_b"].astype(dt)
+        B = h.shape[0]
+        cls = jnp.broadcast_to(params["cls"].astype(dt), (B, 1, c.hidden))
+        h = jnp.concatenate([cls, h], axis=1) + \
+            params["pos_emb"][None].astype(dt)
+        h = bert_lib._layernorm(h, params["emb_ln"])
+        if train and c.dropout > 0.0:
+            if rng is None:
+                raise ValueError("dropout needs an rng in train mode")
+            h = bert_lib.dropout_mask(h, c.dropout,
+                                      jax.random.fold_in(rng, 1))
+        h = h.astype(dt)
+        # the SHARED encoder layer stack; dropout streams continue from
+        # the embedding site exactly like the token path
+        h, _ = self._encoder()._run_layers(
+            {"layers": params["layers"]}, h, train=train, rng=rng,
+            drop_start=1)
+        cls_out = bert_lib._layernorm(h[:, 0].astype(jnp.float32),
+                                      params["head_ln"])
+        logits = cls_out @ params["head_w"] + params["head_b"]
+        return logits.astype(jnp.float32)
+
+    def l2_params(self, params) -> list:
+        return []   # transformer families use decoupled weight decay

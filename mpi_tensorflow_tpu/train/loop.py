@@ -62,6 +62,21 @@ def build_model(config: Config):
 
         return resnet.build(config.model, num_classes=config.num_classes,
                             compute_dtype=dt, remat=config.remat)
+    if config.model == "vit":
+        import dataclasses as dc
+
+        from mpi_tensorflow_tpu.models import vit
+
+        # channels follow the dataset (MNIST is single-channel); patch
+        # size follows the input geometry: 28 -> 7px patches (4x4 grid),
+        # 32 -> 4px (8x8 grid), else 16px (224 -> 14x14 grid)
+        ch = 1 if config.dataset == "mnist" else 3
+        patch = {28: 7, 32: 4}.get(config.image_size, 16)
+        vcfg = dc.replace(vit.VIT_TINY_CIFAR,
+                          image_size=config.image_size, patch=patch,
+                          channels=ch, num_classes=config.num_classes,
+                          dtype=dt, remat=config.remat)
+        return vit.VisionTransformer(vcfg)
     if config.model == "bert_base":
         import dataclasses as dc
 

@@ -56,6 +56,24 @@ def transformer_train_flops(cfg, batch: int, seq_len: int,
     return float(enc + attn + head)
 
 
+def vit_train_flops(vcfg, batch: int) -> float:
+    """One fwd+bwd step of the ViT family (models/vit.py): the SHARED
+    encoder-layer accounting (transformer_train_flops with the vocab
+    head zeroed — ViT drives the same layers, so the same coefficients)
+    at sequence N = patches + CLS, plus the patch projection; the
+    classification head is negligible."""
+    from types import SimpleNamespace
+
+    N = vcfg.num_patches + 1
+    body = transformer_train_flops(
+        SimpleNamespace(hidden=vcfg.hidden, layers=vcfg.layers,
+                        mlp=vcfg.mlp, vocab_size=0),
+        batch, N, head_positions=0)
+    patch = 6 * batch * vcfg.num_patches \
+        * (vcfg.patch ** 2 * vcfg.channels) * vcfg.hidden
+    return float(body + patch)
+
+
 def image_train_flops(model_name: str, batch: int) -> float | None:
     """Model flops for one fwd+bwd step of an image family, or None when
     the model has no canonical number recorded."""

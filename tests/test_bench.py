@@ -103,9 +103,19 @@ class TestMeasureDecode:
         monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
         r = bench.measure_decode(batch_size=2, prompt_len=8, new_tokens=4,
                                  precision="fp32", iters=3)
+        assert r["num_beams"] == 0
         # slope timing: n_long - n_short == new_tokens extra decode steps
         assert r["decode_lengths"][1] - r["decode_lengths"][0] == 4
         # a tenancy stall can order the arms backwards (flagged, NaN value);
         # on a quiet CPU the slope must be positive
         assert r["timing_degenerate"] or r["decode_tokens_per_sec"] > 0
         assert r["new_tokens"] == 4 and r["batch_size"] == 2
+
+    def test_decode_beam_mode(self, monkeypatch):
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_decode(batch_size=2, prompt_len=8, new_tokens=4,
+                                 precision="fp32", iters=2, num_beams=3)
+        assert r["num_beams"] == 3
+        assert r["timing_degenerate"] or r["decode_tokens_per_sec"] > 0

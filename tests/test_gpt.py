@@ -175,6 +175,75 @@ class TestDecode:
             model.init_cache(1, TINY.max_positions + 1)
 
 
+class TestBeamSearch:
+    def _setup(self, b=2, s=8):
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        return model, params, _tokens(b=b, s=s)
+
+    def _score_with_full_forward(self, model, params, seq, S0):
+        """Recompute a sequence's decode log-prob with the plain (no
+        cache) forward — the independent oracle for beam scores."""
+        logits = np.asarray(model.apply(params, jnp.asarray(seq[None])))[0]
+        logp = jax.nn.log_softmax(jnp.asarray(logits), axis=-1)
+        return float(sum(
+            logp[t - 1, seq[t]] for t in range(S0, len(seq))))
+
+    def test_beam1_is_greedy(self):
+        model, params, toks = self._setup()
+        greedy = np.asarray(model.generate(params, toks, 6))
+        seqs, scores = model.beam_search(params, toks, 6, num_beams=1)
+        np.testing.assert_array_equal(np.asarray(seqs)[:, 0], greedy)
+
+    def test_scores_match_full_forward_rescoring(self):
+        model, params, toks = self._setup(b=2, s=6)
+        seqs, scores = model.beam_search(params, toks, 5, num_beams=3)
+        seqs, scores = np.asarray(seqs), np.asarray(scores)
+        for b in range(2):
+            for k in range(3):
+                want = self._score_with_full_forward(
+                    model, params, seqs[b, k], S0=6)
+                assert scores[b, k] == pytest.approx(want, abs=2e-3), \
+                    f"beam {b},{k}"
+
+    def test_scores_sorted_and_monotone_in_width(self):
+        model, params, toks = self._setup(b=1, s=6)
+        _, s2 = model.beam_search(params, toks, 4, num_beams=2)
+        _, s4 = model.beam_search(params, toks, 4, num_beams=4)
+        s2, s4 = np.asarray(s2)[0], np.asarray(s4)[0]
+        assert all(s2[i] >= s2[i + 1] for i in range(len(s2) - 1))
+        assert all(s4[i] >= s4[i + 1] for i in range(len(s4) - 1))
+        # a wider beam can only improve (or match) the best hypothesis
+        assert s4[0] >= s2[0] - 1e-5
+
+    def test_beam_top1_at_least_greedy_score(self):
+        """Beam search's whole point: the top hypothesis scores >= the
+        greedy path's log-prob."""
+        model, params, toks = self._setup(b=2, s=6)
+        greedy = np.asarray(model.generate(params, toks, 5))
+        seqs, scores = model.beam_search(params, toks, 5, num_beams=4)
+        for b in range(2):
+            g = self._score_with_full_forward(model, params, greedy[b], 6)
+            assert float(np.asarray(scores)[b, 0]) >= g - 2e-3
+
+    def test_jit_and_shapes(self):
+        model, params, toks = self._setup(b=2, s=8)
+        seqs, scores = jax.jit(
+            lambda p, t: model.beam_search(p, t, 3, num_beams=5))(
+                params, toks)
+        assert seqs.shape == (2, 5, 11) and scores.shape == (2, 5)
+        np.testing.assert_array_equal(
+            np.asarray(seqs)[:, :, :8],
+            np.broadcast_to(np.asarray(toks)[:, None], (2, 5, 8)))
+
+    def test_guards(self):
+        model, params, toks = self._setup()
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            model.beam_search(params, toks, 0)
+        with pytest.raises(ValueError, match="num_beams"):
+            model.beam_search(params, toks, 2, num_beams=0)
+
+
 class TestSamplingFilters:
     """top-k / top-p (nucleus) sampling: the filters run in sorted logit
     space and map back through the sort indices — these tests pin that a
