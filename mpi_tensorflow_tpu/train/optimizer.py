@@ -117,6 +117,16 @@ def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
     return schedule
 
 
+def decay_mask(params):
+    """The BERT-recipe weight-decay mask: decay matrices, skip LayerNorm
+    scales/biases and every bias vector.  Identified structurally —
+    ndim >= 2 — which matches the transformer families' pytrees exactly
+    (weights are >= 2-D; ln scales, biases, and the tied decoder's out_b
+    are 1-D).  Decaying norms/biases is a silent recipe deviation that
+    costs convergence at scale."""
+    return jax.tree.map(lambda p: jnp.ndim(p) >= 2, params)
+
+
 def transformer_tx(base_lr: float, num_steps: int, *,
                    schedule: str = "warmup_linear",
                    warmup_fraction: float = 0.1,
@@ -145,9 +155,9 @@ def transformer_tx(base_lr: float, num_steps: int, *,
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
     if optimizer == "adamw":
-        tx = optax.adamw(lr, weight_decay=weight_decay)
+        tx = optax.adamw(lr, weight_decay=weight_decay, mask=decay_mask)
     elif optimizer == "lamb":
-        tx = optax.lamb(lr, weight_decay=weight_decay)
+        tx = optax.lamb(lr, weight_decay=weight_decay, mask=decay_mask)
     else:
         raise ValueError(f"unknown optimizer {optimizer!r}")
     if grad_clip_norm and grad_clip_norm > 0:

@@ -173,6 +173,21 @@ class TestOptimizer:
         with pytest.raises(ValueError, match="unknown optimizer"):
             optimizer.transformer_tx(1e-3, 100, optimizer="sgd")
 
+    def test_weight_decay_skips_norms_and_biases(self):
+        """BERT recipe: decay applies to matrices only.  With zero grads,
+        adamw's update is pure decay — 1-D params must not move."""
+        import jax.numpy as jnp
+
+        params = {"w": jnp.ones((3, 3)), "ln": {"scale": jnp.ones((3,))},
+                  "b": jnp.ones((3,))}
+        grads = jax.tree.map(jnp.zeros_like, params)
+        tx = optimizer.transformer_tx(1.0, 10, schedule="constant",
+                                      weight_decay=0.1, grad_clip_norm=0.0)
+        upd, _ = tx.update(grads, tx.init(params), params)
+        assert float(jnp.abs(upd["w"]).sum()) > 0        # decayed
+        assert float(jnp.abs(upd["b"]).sum()) == 0       # not decayed
+        assert float(jnp.abs(upd["ln"]["scale"]).sum()) == 0
+
     def test_lamb_trust_ratio_scales_update_to_param_norm(self):
         """LAMB's defining property (You et al. 2019): the raw adam-style
         update is rescaled by |param| / |update| per layer, so two layers
