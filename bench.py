@@ -80,7 +80,8 @@ def measure_bert(batch_size: int, steps: int, precision: str,
                  model_name: str = "bert_base", remat: bool = False,
                  params_bf16: bool = False, prng_impl: str = "threefry",
                  fused_qkv: bool = False,
-                 flash_min_seq: int | None = None) -> dict:
+                 flash_min_seq: int | None = None,
+                 remat_policy: str = "full") -> dict:
     """BERT-base MLM train-step throughput (BASELINE config 5) via the
     GSPMD path — adamw, tied-decoder MLM loss, scanned dispatches.
     ``model_name="moe_bert"`` swaps in the capacity-routed MoE variant
@@ -103,7 +104,7 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     global_b = batch_size * ndev
     bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype,
                       ce_impl=ce_impl, ce_chunk=ce_chunk, remat=remat,
-                      fused_qkv=fused_qkv,
+                      remat_policy=remat_policy, fused_qkv=fused_qkv,
                       max_positions=max(bert.BERT_BASE.max_positions,
                                         seq_len),
                       **({} if flash_min_seq is None
@@ -181,6 +182,8 @@ def measure_bert(batch_size: int, steps: int, precision: str,
         "prng_impl": prng_impl,
         "fused_qkv": fused_qkv,
         "flash_min_seq": bcfg.flash_min_seq,
+        "remat": remat,
+        "remat_policy": remat_policy,
         "platform": jax.devices()[0].platform,
     }
 
@@ -508,6 +511,12 @@ def main(argv=None) -> int:
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize residual blocks / encoder layers "
                          "(frees HBM for larger batches)")
+    ap.add_argument("--remat-policy", choices=["full", "dots"],
+                    default="full",
+                    help="what a rematted transformer layer saves: full "
+                         "= nothing (max recompute), dots = keep matmul "
+                         "outputs, recompute only elementwise (MXU work "
+                         "not repeated)")
     ap.add_argument("--flash-min-seq", type=int, default=None,
                     help="engage the Pallas flash-attention kernel only at "
                          "seq_len >= this (default: the model's measured "
@@ -555,6 +564,13 @@ def main(argv=None) -> int:
     if args.prng != "threefry" and args.record_baseline:
         ap.error("--record-baseline stores the canonical reference-"
                  "semantics run; keep the default threefry stream")
+    if args.remat_policy != "full" and not args.remat:
+        ap.error("--remat-policy only applies with --remat")
+    if args.remat_policy != "full" and (
+            args.mode != "train" or args.model not in
+            ("bert_base", "moe_bert", "gpt_base")):
+        ap.error("--remat-policy applies to the transformer families in "
+                 "train mode only — other paths would silently ignore it")
     if args.flash_min_seq is not None and (
             args.mode != "train" or args.model not in
             ("bert_base", "moe_bert", "gpt_base")):
@@ -652,7 +668,8 @@ def main(argv=None) -> int:
                               ce_chunk=args.ce_chunk, model_name=args.model,
                               remat=args.remat, params_bf16=args.params_bf16,
                               prng_impl=args.prng, fused_qkv=args.fused_qkv,
-                              flash_min_seq=args.flash_min_seq)
+                              flash_min_seq=args.flash_min_seq,
+                              remat_policy=args.remat_policy)
         label = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",
                  "gpt_base": "GPT-base causal LM"}.get(args.model,
                                                        "BERT-base MLM")

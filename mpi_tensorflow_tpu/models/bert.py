@@ -53,6 +53,16 @@ class BertConfig:
                                   # recompute activations in the backward
                                   # pass — peak activation HBM drops from
                                   # O(layers) to O(1) residual streams
+    remat_policy: str = "full"    # what a rematted layer SAVES: "full"
+                                  # = nothing (maximum recompute, minimum
+                                  # HBM); "dots" = keep matmul outputs
+                                  # (jax.checkpoint_policies.
+                                  # dots_with_no_batch_dims_saveable) and
+                                  # recompute only the cheap elementwise —
+                                  # the usual TPU sweet spot: the MXU work
+                                  # is not repeated, and saved dot outputs
+                                  # are the activations XLA would keep
+                                  # anyway at ~half the HBM of no-remat
     ce_impl: str = "auto"         # MLM loss: "chunked" = online-logsumexp
                                   # over vocab tiles, never materializing
                                   # (B,S,V) fp32 logits (ops/mlm_head.py);
@@ -133,6 +143,19 @@ def ce_capacity(cfg, S: int) -> int:
     and the pipelined 1F1B microbatch loss — the schedules' loss parity
     depends on both computing the identical cap."""
     return min(S, max(8, -(-int(cfg.ce_capacity_frac * S) // 8) * 8))
+
+
+def remat_policy_fn(cfg):
+    """Resolve ``cfg.remat_policy`` to a ``jax.checkpoint`` policy —
+    the ONE mapping shared by the encoder stack and the pipeline
+    schedules (a policy honored on one path and silently ignored on
+    another would make ``remat_policy`` a per-path lie).  ``None`` =
+    save nothing (the "full" recompute)."""
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    if cfg.remat_policy == "full":
+        return None
+    raise ValueError(f"unknown remat_policy {cfg.remat_policy!r}")
 
 
 def dropout_mask(x, rate: float, key):
@@ -405,7 +428,9 @@ class BertMlm:
             # trade FLOPs for HBM: drop each layer's activations after the
             # forward pass and recompute them during the backward pass —
             # peak activation memory goes from O(layers) to O(1) residuals
-            layer = jax.checkpoint(layer, static_argnums=(3,))
+            # (plus saved dot outputs under the "dots" policy)
+            layer = jax.checkpoint(layer, static_argnums=(3,),
+                                   policy=remat_policy_fn(c))
         aux_total = jnp.zeros((), jnp.float32)
         for i, lp in enumerate(params["layers"]):
             # dropout keys derived OUTSIDE the (possibly rematted) layer so

@@ -199,8 +199,11 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             # recompute stage activations in the backward pipeline: the
             # scanned schedule then stores only stage-boundary activations
             # per tick instead of every layer's internals (the GPipe
-            # activation-memory story)
-            body = jax.checkpoint(body)
+            # activation-memory story).  The remat_policy mapping is the
+            # shared one (bert.remat_policy_fn) — "dots" keeps matmul
+            # outputs here exactly as on the non-pipelined path
+            body = jax.checkpoint(
+                body, policy=bert_lib.remat_policy_fn(self.cfg))
         h, _ = lax.scan(body, x, (stage_params, jnp.arange(Lp)))
         return h
 

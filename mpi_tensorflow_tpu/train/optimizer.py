@@ -117,14 +117,36 @@ def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
     return schedule
 
 
+_BIAS_NAME = __import__("re").compile(r"^(b[a-z0-9]?|eb\d)$")
+
+
+def _leaf_name(path) -> str:
+    """Last dict key on a tree path ('' for pure-sequence paths)."""
+    for entry in reversed(path):
+        key = getattr(entry, "key", None)
+        if isinstance(key, str):
+            return key
+    return ""
+
+
 def decay_mask(params):
-    """The BERT-recipe weight-decay mask: decay matrices, skip LayerNorm
-    scales/biases and every bias vector.  Identified structurally —
-    ndim >= 2 — which matches the transformer families' pytrees exactly
-    (weights are >= 2-D; ln scales, biases, and the tied decoder's out_b
-    are 1-D).  Decaying norms/biases is a silent recipe deviation that
-    costs convergence at scale."""
-    return jax.tree.map(lambda p: jnp.ndim(p) >= 2, params)
+    """The BERT-recipe weight-decay mask: decay weight matrices, skip
+    LayerNorm scales/biases and every bias — by NAME, not just ndim,
+    because the MoE family's per-expert biases (``eb1``: (E, mlp),
+    ``eb2``: (E, hidden)) are 2-D and a structural rule would silently
+    decay them.  Bias-like names across the families: ``b``/``bq``/
+    ``bk``/``bv``/``bo``/``b1``/``b2``, ``eb1``/``eb2``, ``*_b``
+    (``out_b``, ``patch_b``, ``head_b``), and the ``scale``/``bias``
+    LayerNorm leaves.  Decaying norms/biases is a silent recipe
+    deviation that costs convergence at scale."""
+    def decayable(path, p):
+        name = _leaf_name(path)
+        if name in ("scale", "bias") or name.endswith("_b") \
+                or _BIAS_NAME.match(name):
+            return False
+        return jnp.ndim(p) >= 2
+
+    return jax.tree_util.tree_map_with_path(decayable, params)
 
 
 def transformer_tx(base_lr: float, num_steps: int, *,

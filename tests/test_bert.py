@@ -174,6 +174,41 @@ class TestRemat:
                 np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
             gr, gp)
 
+    def test_remat_dots_policy_matches_plain(self):
+        """The 'dots' policy (save matmul outputs, recompute elementwise)
+        changes what is SAVED, never the math: logits and grads must
+        match the plain model, dropout masks included."""
+        import dataclasses as dc
+
+        cfg_p = dc.replace(bert.BERT_TINY, dropout=0.1)
+        cfg_d = dc.replace(cfg_p, remat=True, remat_policy="dots")
+        m_p, m_d = bert.BertMlm(cfg_p), bert.BertMlm(cfg_d)
+        params = m_p.init(jax.random.key(0))
+        tokens = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg_p.vocab_size, (2, 16)),
+            jnp.int32)
+        key = jax.random.key(9)
+        np.testing.assert_allclose(
+            np.asarray(m_d.apply(params, tokens, train=True, rng=key)),
+            np.asarray(m_p.apply(params, tokens, train=True, rng=key)),
+            rtol=1e-6, atol=1e-6)
+
+        def loss(m):
+            def f(p):
+                out = m.apply(p, tokens, train=True, rng=key)
+                return jnp.sum(out ** 2) / out.size
+            return f
+
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+            jax.grad(loss(m_d))(params), jax.grad(loss(m_p))(params))
+
+        with pytest.raises(ValueError, match="remat_policy"):
+            bert.BertMlm(dc.replace(cfg_p, remat=True,
+                                    remat_policy="nope")) \
+                .apply(params, tokens)
+
     def test_fused_qkv_forward_and_grads_match(self):
         """fused_qkv changes dispatch shape, not math: one stacked
         (E, 3HD) matmul must reproduce the three separate projections
