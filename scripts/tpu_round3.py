@@ -101,7 +101,7 @@ ITEMS = ["bert_diagnose", "bert_profile", "resnet_profile",
          "bert_rbg_fused", "bert_b128", "bert_b256",
          "bert_s2048_flash_remat", "bert_s2048_remat_dots",
          "bert_s4096_flash", "bert_s4096_xla",
-         "resnet50_b32",
+         "vit_b128", "resnet50_b32",
          "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
          "gpt_base", "decode", "bert_s512", "bert_s2048", "mnist",
          "resnet20", "allreduce", "bert_noflash", "bert_s2048_noflash"]
@@ -154,6 +154,9 @@ def main():
     run_item("bert_s4096_xla", lambda: bench.measure_bert(
         batch_size=2, steps=8, precision="bf16", scan_steps=2,
         seq_len=4096, remat=True, flash_min_seq=1 << 30))
+    run_item("vit_b128", lambda: bench.measure(
+        batch_size=128, steps=200, precision="bf16", scan_steps=20,
+        model_name="vit"))
     run_item("resnet50_b32", lambda: bench.measure(
         batch_size=32, steps=48, precision="bf16", scan_steps=8,
         model_name="resnet50"))

@@ -356,6 +356,30 @@ class TestShardedDecode:
         # argmax tie, so greedy tokens must match exactly
         np.testing.assert_array_equal(got, want)
 
+    def test_sharded_beam_search_matches_single_device(self):
+        """Beam search under DP x TP: the beams fold into the batch dim
+        (data-sharded), the cache reindex gathers along that folded dim —
+        tokens and scores must match the single-device run exactly."""
+        mesh = self._mesh()
+        single = gpt.CausalLm(TINY)
+        params = single.init(jax.random.key(0))
+        toks = _tokens(b=4, s=10, seed=9)
+        want_s, want_sc = jax.jit(
+            lambda p, t: single.beam_search(p, t, 6, num_beams=3))(
+                params, toks)
+
+        from mpi_tensorflow_tpu.parallel import sharding_rules as rules_lib
+
+        sharded = gpt.CausalLm(TINY, mesh=mesh)
+        placed = rules_lib.shard_tree(params, single.logical_axes(), mesh)
+        got_s, got_sc = jax.jit(
+            lambda p, t: sharded.beam_search(p, t, 6, num_beams=3))(
+                placed, toks)
+        np.testing.assert_array_equal(np.asarray(got_s),
+                                      np.asarray(want_s))
+        np.testing.assert_allclose(np.asarray(got_sc),
+                                   np.asarray(want_sc), rtol=1e-5)
+
     def test_sharded_prefill_logits_match(self):
         mesh = self._mesh()
         single = gpt.CausalLm(TINY)

@@ -387,12 +387,12 @@ class TestPipelineDropout:
         want = np.asarray(x) + Pstages * np.arange(M)[:, None, None]
         np.testing.assert_allclose(got, want)
 
-    def _model(self, mesh, dropout=0.1, remat=False):
+    def _model(self, mesh, dropout=0.1, remat=False, remat_policy="full"):
         from mpi_tensorflow_tpu.models import bert_pipeline
 
         cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
                               mlp=64, max_positions=32, dropout=dropout,
-                              remat=remat)
+                              remat=remat, remat_policy=remat_policy)
         return bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh,
                                               num_microbatches=2)
 
@@ -449,6 +449,31 @@ class TestPipelineDropout:
                                            train=True)[0])(params)
         g2 = jax.grad(lambda p: remat.loss(p, None, batch, targets, rng=key,
                                            train=True)[0])(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g1, g2)
+
+    def test_remat_dots_policy_through_pipeline(self, mesh_pd):
+        """The 'dots' remat policy is honored ON THE PIPELINE PATH (the
+        shared bert.remat_policy_fn mapping): loss must equal the plain
+        pipelined model's, same rng."""
+        plain = self._model(mesh_pd, remat=False)
+        dots = self._model(mesh_pd, remat=True, remat_policy="dots")
+        params = plain.init(jax.random.key(0))
+        params = sharding_rules.shard_tree(params, plain.logical_axes(),
+                                           mesh_pd)
+        batch, targets = self._batch(plain.cfg)
+        key = jax.random.key(5)
+        l1, _ = plain.loss(params, None, batch, targets, rng=key,
+                           train=True)
+        l2, _ = dots.loss(params, None, batch, targets, rng=key,
+                          train=True)
+        np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+        # the policy's only observable effect is in the BACKWARD pass
+        # (what gets rematerialized) — grads must match too
+        g1 = jax.grad(lambda p: plain.loss(p, None, batch, targets,
+                                           rng=key, train=True)[0])(params)
+        g2 = jax.grad(lambda p: dots.loss(p, None, batch, targets,
+                                          rng=key, train=True)[0])(params)
         jax.tree.map(lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6), g1, g2)
 
