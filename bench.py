@@ -43,6 +43,7 @@ MODEL_SPECS = {
     "bert_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
     "moe_bert": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
     "gpt_base": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
+    "encdec_t5": dict(batch=64, seq=128, scan=4, steps=32, unit="tokens"),
 }
 
 # display names for the image-family metric line; tests pin that every
@@ -118,6 +119,10 @@ def measure_bert(batch_size: int, steps: int, precision: str,
 
         # causal LM: every position carries loss (ce_positions is unused)
         model = gpt.CausalLm(bcfg, mesh=mesh)
+    elif model_name == "encdec_t5":
+        from mpi_tensorflow_tpu.models import encdec
+
+        model = encdec.EncDecLm(bcfg)
     else:
         model = bert.BertMlm(bcfg, mesh=mesh)
     tx = optax.adamw(1e-4)
@@ -129,17 +134,26 @@ def measure_bert(batch_size: int, steps: int, precision: str,
     multi = gspmd.make_gspmd_multi_step(model, mesh, tx)
 
     K = max(1, min(scan_steps, steps))
-    toks, tgts, mask = synthetic.mlm_batches(
-        K * global_b, seq_len=seq_len, vocab_size=bcfg.vocab_size, seed=0)
     shape = (K, global_b, seq_len)
     # leading axis is the scan (step) axis — batch dim 1 shards over 'data'
     # (gspmd.shard_batch would wrongly map dim 0 to 'data' here)
     import jax.sharding as shd
 
     sh = shd.NamedSharding(mesh, shd.PartitionSpec(None, "data"))
-    batches = {"tokens": jax.device_put(toks.reshape(shape), sh),
-               "mask": jax.device_put(mask.reshape(shape), sh)}
-    labels = jax.device_put(tgts.reshape(shape), sh)
+    if model_name == "encdec_t5":
+        src, tgt = synthetic.seq2seq_batches(
+            K * global_b, src_len=seq_len, tgt_len=seq_len,
+            vocab_size=bcfg.vocab_size, seed=0)
+        batches = {"src": jax.device_put(src.reshape(shape), sh),
+                   "tgt": jax.device_put(tgt.reshape(shape), sh)}
+        labels = batches["tgt"]
+    else:
+        toks, tgts, mask = synthetic.mlm_batches(
+            K * global_b, seq_len=seq_len, vocab_size=bcfg.vocab_size,
+            seed=0)
+        batches = {"tokens": jax.device_put(toks.reshape(shape), sh),
+                   "mask": jax.device_put(mask.reshape(shape), sh)}
+        labels = jax.device_put(tgts.reshape(shape), sh)
 
     from mpi_tensorflow_tpu.ops import flash_attention as fa
     from mpi_tensorflow_tpu.utils import engagement
@@ -154,10 +168,14 @@ def measure_bert(batch_size: int, steps: int, precision: str,
 
     # MoE routes each token through ONE expert of the same width, so the
     # dense formula holds per token; causal counts every position at the
-    # head
-    step_flops = flops_lib.transformer_train_flops(
-        bcfg, batch_size, seq_len,
-        head_positions=seq_len if causal else None)
+    # head; the enc-dec family adds decoder + cross-attention terms
+    if model_name == "encdec_t5":
+        step_flops = flops_lib.encdec_train_flops(
+            bcfg, model.n_dec, batch_size, seq_len, seq_len)
+    else:
+        step_flops = flops_lib.transformer_train_flops(
+            bcfg, batch_size, seq_len,
+            head_positions=seq_len if causal else None)
     return {
         "model_flops_per_step": step_flops,
         "mfu_pct": flops_lib.mfu_pct(step_flops, sec, precision,
@@ -546,7 +564,7 @@ def main(argv=None) -> int:
 
     if args.seq_len is not None:
         if args.mode != "train" or args.model not in (
-                "bert_base", "moe_bert", "gpt_base"):
+                "bert_base", "moe_bert", "gpt_base", "encdec_t5"):
             ap.error("--seq-len applies to the transformer families in "
                      "train mode only (decode uses --prompt-len/"
                      "--new-tokens)")
@@ -554,7 +572,7 @@ def main(argv=None) -> int:
             ap.error(f"--seq-len must be >= 1, got {args.seq_len}")
 
     if args.fused_qkv and (args.mode != "train" or args.model not in
-                           ("bert_base", "moe_bert", "gpt_base")):
+                           ("bert_base", "moe_bert", "gpt_base", "encdec_t5")):
         ap.error("--fused-qkv applies to the transformer families in train "
                  "mode only — other paths would silently ignore it")
     if args.prng != "threefry" and args.mode != "train":
@@ -568,12 +586,12 @@ def main(argv=None) -> int:
         ap.error("--remat-policy only applies with --remat")
     if args.remat_policy != "full" and (
             args.mode != "train" or args.model not in
-            ("bert_base", "moe_bert", "gpt_base")):
+            ("bert_base", "moe_bert", "gpt_base", "encdec_t5")):
         ap.error("--remat-policy applies to the transformer families in "
                  "train mode only — other paths would silently ignore it")
     if args.flash_min_seq is not None and (
             args.mode != "train" or args.model not in
-            ("bert_base", "moe_bert", "gpt_base")):
+            ("bert_base", "moe_bert", "gpt_base", "encdec_t5")):
         ap.error("--flash-min-seq applies to the transformer families in "
                  "train mode only — other paths would silently ignore it")
 
@@ -648,18 +666,18 @@ def main(argv=None) -> int:
         # bf16-rounded weights while reporting precision=fp32
         ap.error("--params-bf16 requires --precision bf16 (fp32 compute "
                  "with bf16-truncated weights is not the fp32 baseline)")
-    if args.params_bf16 and args.model not in ("bert_base", "moe_bert",
-                                               "gpt_base"):
+    if args.params_bf16 and args.model not in (
+            "bert_base", "moe_bert", "gpt_base", "encdec_t5"):
         ap.error("--params-bf16 is implemented for the transformer families "
-                 "(bert_base, moe_bert, gpt_base) only — the image paths "
-                 "would silently ignore it")
+                 "(bert_base, moe_bert, gpt_base, encdec_t5) only — the "
+                 "image paths would silently ignore it")
 
     spec = MODEL_SPECS[args.model]
     batch = args.batch_size if args.batch_size is not None else spec["batch"]
     steps = args.steps or spec["steps"]
     scan = args.scan_steps if args.scan_steps is not None else spec["scan"]
 
-    if args.model in ("bert_base", "moe_bert", "gpt_base"):
+    if args.model in ("bert_base", "moe_bert", "gpt_base", "encdec_t5"):
         result = measure_bert(batch_size=batch, steps=steps,
                               precision=args.precision, scan_steps=scan,
                               seq_len=(args.seq_len if args.seq_len is not None
@@ -671,8 +689,9 @@ def main(argv=None) -> int:
                               flash_min_seq=args.flash_min_seq,
                               remat_policy=args.remat_policy)
         label = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",
-                 "gpt_base": "GPT-base causal LM"}.get(args.model,
-                                                       "BERT-base MLM")
+                 "gpt_base": "GPT-base causal LM",
+                 "encdec_t5": "Encoder-decoder LM (cross-attention)"} \
+            .get(args.model, "BERT-base MLM")
         _print_json({
             "metric": f"{label} train-step throughput "
                       "(GSPMD, eval off timed path)",

@@ -51,7 +51,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", default=d.data_dir)
     p.add_argument("--model", default=d.model,
                    choices=["mnist_cnn", "resnet20", "resnet50", "vit",
-                            "bert_base", "moe_bert", "gpt_base"])
+                            "bert_base", "moe_bert", "gpt_base",
+                            "encdec_t5"])
     p.add_argument("--dataset", default=d.dataset,
                    choices=["mnist", "cifar10", "imagenet_synthetic",
                             "mlm_synthetic"])
@@ -171,7 +172,7 @@ def main(argv=None) -> int:
     if config.vocab_file and not config.text_file:
         raise SystemExit("--vocab-file only applies with --text-file")
     if config.optimizer != "adamw" and config.model not in (
-            "bert_base", "moe_bert", "gpt_base"):
+            "bert_base", "moe_bert", "gpt_base", "encdec_t5"):
         raise SystemExit(
             f"--optimizer {config.optimizer} applies to the transformer "
             f"families; the image families train with the reference's "
@@ -184,7 +185,8 @@ def main(argv=None) -> int:
     from mpi_tensorflow_tpu.utils import profiling
 
     def run_once():
-        if config.model in ("bert_base", "moe_bert", "gpt_base"):
+        if config.model in ("bert_base", "moe_bert", "gpt_base",
+                            "encdec_t5"):
             from mpi_tensorflow_tpu.train import mlm_loop
 
             return mlm_loop.train_mlm(config)

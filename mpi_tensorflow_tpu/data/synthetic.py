@@ -72,3 +72,27 @@ def mlm_batches(num_examples: int, *, seq_len: int, vocab_size: int,
     mask = rng.random((num_examples, seq_len)) < mask_rate
     tokens = np.where(mask, mask_token, clean)
     return (tokens.astype(np.int32), clean.astype(np.int32), mask)
+
+
+def seq2seq_batches(num_examples: int, *, src_len: int, tgt_len: int,
+                    vocab_size: int, bos_token: int = 0, seed: int = 0):
+    """Synthetic sequence-to-sequence data for the encoder-decoder
+    family: the target is the REVERSED source (BOS-seeded, truncated to
+    ``tgt_len``).  Reversal forces the decoder through cross-attention —
+    position t of the target copies position S-1-t of the source, which
+    no causal-self-attention shortcut can produce.
+
+    Returns ``(src, tgt)`` int32 arrays (N, src_len) / (N, tgt_len);
+    ``tgt[:, 0]`` is BOS, positions 1.. are supervised.
+    """
+    if tgt_len > src_len + 1:
+        raise ValueError(
+            f"tgt_len {tgt_len} > src_len + 1 ({src_len + 1}): the "
+            f"reversed source cannot fill the target")
+    rng = np.random.default_rng(seed)
+    src = rng.integers(5, vocab_size, size=(num_examples, src_len))
+    rev = src[:, ::-1]
+    tgt = np.concatenate(
+        [np.full((num_examples, 1), bos_token), rev[:, :tgt_len - 1]],
+        axis=1)
+    return src.astype(np.int32), tgt.astype(np.int32)

@@ -65,6 +65,15 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         from mpi_tensorflow_tpu.models import gpt
 
         model = gpt.CausalLm(bert_cfg, mesh=mesh)
+    elif config.model == "encdec_t5":
+        from mpi_tensorflow_tpu.models import encdec
+
+        if any(v > 1 for k, v in mesh.shape.items() if k != "data"):
+            raise ValueError(
+                f"the encoder-decoder family is data-parallel only this "
+                f"round (mesh {dict(mesh.shape)}); drop the non-data "
+                f"axes rather than silently ignoring them")
+        model = encdec.EncDecLm(bert_cfg)
     elif mesh.shape.get("pipe", 1) > 1:
         from mpi_tensorflow_tpu.models import bert_pipeline
 
@@ -73,7 +82,23 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     else:
         model = bert.BertMlm(bert_cfg, mesh=mesh)
 
-    if getattr(config, "text_file", None):
+    enc_dec = config.model == "encdec_t5"
+    if enc_dec:
+        if getattr(config, "text_file", None):
+            raise ValueError(
+                "--text-file is a single-stream input; the encoder-"
+                "decoder family trains on (src, tgt) pairs (synthetic "
+                "reversal task)")
+        # the synthetic reversal task: tgt = BOS + reverse(src) — forces
+        # the decoder through cross-attention.  tokens/targets below hold
+        # src/tgt; mask is unused (every tgt position carries loss)
+        tokens, targets = synthetic.seq2seq_batches(
+            train_n, src_len=seq_len, tgt_len=seq_len,
+            vocab_size=bert_cfg.vocab_size, seed=config.seed)
+        ts_tokens, ts_targets = synthetic.seq2seq_batches(
+            test_n, src_len=seq_len, tgt_len=seq_len,
+            vocab_size=bert_cfg.vocab_size, seed=config.seed + 1)
+    elif getattr(config, "text_file", None):
         # real text, byte-level or WordPiece per --vocab-file
         # (data/corpus.py); the trailing rows become the held-out split
         from mpi_tensorflow_tpu.data import corpus
@@ -157,9 +182,19 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     def masked_error(s) -> float:
         """Held-out error %: masked-position prediction error for the MLM
         families; next-token prediction error (position t predicts t+1)
-        for the causal family."""
+        for the causal family; teacher-forced target-side next-token
+        error for the encoder-decoder family."""
         errs, tot = 0, 0
         for idx, take in _eval_index_batches():
+            if enc_dec:
+                pair = gspmd.shard_batch(
+                    {"src": ts_tokens[idx], "tgt": ts_targets[idx]}, mesh)
+                logits = np.asarray(eval_step(s, pair))
+                pred = logits.argmax(-1)[:take]
+                tgt_rows = np.asarray(ts_targets[idx[:take]])
+                errs += int((pred[:, :-1] != tgt_rows[:, 1:]).sum())
+                tot += int(np.prod(tgt_rows[:, 1:].shape))
+                continue
             tok = gspmd.shard_batch(ts_tokens[idx], mesh)
             logits = np.asarray(eval_step(s, tok))
             pred = logits.argmax(-1)[:take]
@@ -179,8 +214,14 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     try:
         for t in range(start_step, num_steps):
             lo = (t * b) % max(train_n - b, 1)
-            batch = gspmd.shard_batch(
-                {"tokens": tokens[lo:lo + b], "mask": mask[lo:lo + b]}, mesh)
+            if enc_dec:
+                batch = gspmd.shard_batch(
+                    {"src": tokens[lo:lo + b], "tgt": targets[lo:lo + b]},
+                    mesh)
+            else:
+                batch = gspmd.shard_batch(
+                    {"tokens": tokens[lo:lo + b],
+                     "mask": mask[lo:lo + b]}, mesh)
             tgt = gspmd.shard_batch(targets[lo:lo + b], mesh)
             state, metrics = train_step(state, batch, tgt, rng)
             pending += 1

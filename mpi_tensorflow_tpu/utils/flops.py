@@ -56,6 +56,23 @@ def transformer_train_flops(cfg, batch: int, seq_len: int,
     return float(enc + attn + head)
 
 
+def encdec_train_flops(cfg, n_dec: int, batch: int, src_len: int,
+                       tgt_len: int) -> float:
+    """One fwd+bwd step of the encoder-decoder family (models/encdec.py):
+    the shared encoder accounting (head zeroed) + decoder layers
+    (self-attn QKV/out and MLP at T; cross q/out at T; cross k/v at S)
+    + causal self-attention (T²), cross-attention (T·S), and the tied
+    vocab head over every target position."""
+    E, M, V = cfg.hidden, cfg.mlp, cfg.vocab_size
+    B, S, T = batch, src_len, tgt_len
+    enc = transformer_train_flops(cfg, B, S, head_positions=0)
+    dec_mm = 6 * n_dec * (B * T * (6 * E * E + 2 * E * M)
+                          + B * S * 2 * E * E)
+    attn = 12 * n_dec * B * E * (T * T + T * S)
+    head = 6 * B * T * V * E
+    return float(enc + dec_mm + attn + head)
+
+
 def vit_train_flops(vcfg, batch: int) -> float:
     """One fwd+bwd step of the ViT family (models/vit.py): the SHARED
     encoder-layer accounting (transformer_train_flops with the vocab

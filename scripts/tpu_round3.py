@@ -103,7 +103,8 @@ ITEMS = ["bert_diagnose", "bert_profile", "resnet_profile",
          "bert_s4096_flash", "bert_s4096_xla",
          "vit_b128", "resnet50_b32",
          "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
-         "gpt_base", "decode", "bert_s512", "bert_s2048", "mnist",
+         "gpt_base", "encdec_t5", "decode", "bert_s512", "bert_s2048",
+         "mnist",
          "resnet20", "allreduce", "bert_noflash", "bert_s2048_noflash"]
 
 
@@ -172,6 +173,9 @@ def main():
     run_item("gpt_base", lambda: bench.measure_bert(
         batch_size=64, steps=32, precision="bf16", scan_steps=4,
         model_name="gpt_base"))
+    run_item("encdec_t5", lambda: bench.measure_bert(
+        batch_size=64, steps=32, precision="bf16", scan_steps=4,
+        model_name="encdec_t5"))
 
     def decode_item():
         d = bench.measure_decode(precision="bf16")

@@ -44,6 +44,26 @@ def test_attention_term_is_quadratic_in_seq():
     assert attn_only(2 * s) == pytest.approx(4 * attn_only(s))
 
 
+def test_encdec_flops_accounting():
+    cfg = dc.replace(bert.BERT_TINY, ce_positions="all")
+    B, S, T, n_dec = 4, 16, 12, 2
+    f = fl.encdec_train_flops(cfg, n_dec, B, S, T)
+    E, M, V = cfg.hidden, cfg.mlp, cfg.vocab_size
+    enc = fl.transformer_train_flops(cfg, B, S, head_positions=0)
+    dec_mm = 6 * n_dec * (B * T * (6 * E * E + 2 * E * M)
+                          + B * S * 2 * E * E)
+    attn = 12 * n_dec * B * E * (T * T + T * S)
+    head = 6 * B * T * V * E
+    assert f == pytest.approx(enc + dec_mm + attn + head)
+    # the cross-attention term scales with T*S: doubling S adds exactly
+    # the cross + encoder + cross-KV deltas, nothing quadratic in T
+    f2 = fl.encdec_train_flops(cfg, n_dec, B, 2 * S, T)
+    enc2 = fl.transformer_train_flops(cfg, B, 2 * S, head_positions=0)
+    want_delta = (enc2 - enc) + 12 * n_dec * B * E * T * S \
+        + 6 * n_dec * B * S * 2 * E * E
+    assert f2 - f == pytest.approx(want_delta)
+
+
 def test_image_flops_and_unknown_model():
     assert fl.image_train_flops("resnet50", 32) == \
         pytest.approx(3 * 8.2e9 * 32)
