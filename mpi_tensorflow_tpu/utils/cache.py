@@ -1,0 +1,38 @@
+"""Host-scoped persistent-compilation-cache paths.
+
+XLA:CPU stores AOT-compiled executables keyed WITHOUT the full host
+machine-feature set; loading an entry compiled on a different CPU type
+warns "This could lead to execution errors such as SIGILL" — and does
+exactly that, intermittently, when a cached executable using unsupported
+instructions runs (observed twice as a mid-suite "Fatal Python error"
+on the round-3 box, whose cache had accumulated entries from earlier
+rounds' hosts).  Scoping the CPU cache by a fingerprint of the host's
+instruction set makes a foreign entry unreachable instead of fatal.
+TPU entries are unaffected (device executables, loaded by the runtime,
+not host-executed) and keep using the base directory.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import platform
+
+
+def host_scoped_cpu_cache(base: str) -> str:
+    """``base``/cpu-<isa fingerprint> — stable per machine type."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            text = f.read()
+        # x86 lists ISA extensions under "flags", aarch64 under
+        # "Features"; if neither is present, fingerprint the whole file —
+        # a constant fallback would let foreign AOT entries stay
+        # reachable, the exact hazard this module exists to close
+        flags = next((ln for ln in text.splitlines()
+                      if ln.startswith(("flags", "Features"))), text)
+    except OSError:
+        flags = platform.processor() or platform.machine()
+    tag = hashlib.sha1(flags.encode()).hexdigest()[:12]
+    path = os.path.join(base, f"cpu-{tag}")
+    os.makedirs(path, exist_ok=True)
+    return path

@@ -28,9 +28,14 @@ jax.config.update("jax_num_cpu_devices", 8)
 
 # Persistent compilation cache: the transformer-path compiles dominate the
 # suite's wall clock (VERDICT r1: ~18 min); cached compiles make repeat runs
-# and the `-m quick` smoke tier usable as a gate.
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), ".jax_cache")
+# and the `-m quick` smoke tier usable as a gate.  HOST-SCOPED for CPU:
+# loading an XLA:CPU AOT entry compiled on a different machine type can
+# SIGILL ("Fatal Python error" mid-suite, observed twice — see
+# utils/cache.py); a per-ISA subdir makes foreign entries unreachable.
+from mpi_tensorflow_tpu.utils.cache import host_scoped_cpu_cache  # noqa: E402
+
+_CACHE_DIR = host_scoped_cpu_cache(os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
 jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -123,3 +123,16 @@ class TestMnist:
         a = idx.extract_images(str(d1 / mnist.FILES["train_images"]))
         b = idx.extract_images(str(d2 / mnist.FILES["train_images"]))
         np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.quick
+def test_host_scoped_cpu_cache(tmp_path):
+    """Foreign-machine XLA:CPU AOT entries can SIGILL; the cache path
+    must be ISA-fingerprinted, stable, and auto-created."""
+    from mpi_tensorflow_tpu.utils.cache import host_scoped_cpu_cache
+
+    a = host_scoped_cpu_cache(str(tmp_path))
+    b = host_scoped_cpu_cache(str(tmp_path))
+    assert a == b and a.startswith(str(tmp_path)) and "cpu-" in a
+    import os as _os
+    assert _os.path.isdir(a)

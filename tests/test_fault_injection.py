@@ -66,7 +66,11 @@ def _cli_env():
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_NUM_CPU_DEVICES"] = "8"
     env["PYTHONUNBUFFERED"] = "1"
-    env["JAX_COMPILATION_CACHE_DIR"] = os.path.join(REPO, ".jax_cache")
+    from mpi_tensorflow_tpu.utils.cache import host_scoped_cpu_cache
+
+    # host-scoped: a foreign-machine AOT entry can SIGILL (utils/cache.py)
+    env["JAX_COMPILATION_CACHE_DIR"] = host_scoped_cpu_cache(
+        os.path.join(REPO, ".jax_cache"))
     return env
 
 
