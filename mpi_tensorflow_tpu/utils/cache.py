@@ -20,7 +20,9 @@ import platform
 
 
 def host_scoped_cpu_cache(base: str) -> str:
-    """``base``/cpu-<isa fingerprint> — stable per machine type."""
+    """``base``/cpu-<isa fingerprint> — stable per machine type, and
+    idempotent (an already-scoped path is returned unchanged, so every
+    forced-CPU entry point can apply it unconditionally)."""
     try:
         with open("/proc/cpuinfo") as f:
             text = f.read()
@@ -33,6 +35,8 @@ def host_scoped_cpu_cache(base: str) -> str:
     except OSError:
         flags = platform.processor() or platform.machine()
     tag = hashlib.sha1(flags.encode()).hexdigest()[:12]
+    if os.path.basename(os.path.normpath(base)) == f"cpu-{tag}":
+        return base                      # already scoped
     path = os.path.join(base, f"cpu-{tag}")
     os.makedirs(path, exist_ok=True)
     return path
