@@ -84,6 +84,15 @@ class BertConfig:
                                   # (E, HD) matmuls — fewer, larger MXU
                                   # dispatches; parameters stay separate
                                   # (checkpoints/sharding rules unchanged)
+    pos_kind: str = "learned"     # position encoding: "learned" absolute
+                                  # embeddings (the BERT convention) or
+                                  # "rope" rotary (applied to q/k right
+                                  # before the attention dispatch, so
+                                  # dense/flash/ring/Ulysses and the
+                                  # KV-cache decode all inherit it; the
+                                  # pos_emb table stays in the pytree
+                                  # unused, keeping checkpoint layout
+                                  # stable across the knob)
     flash_min_seq: int = 4096     # engage the Pallas flash kernel only at
                                   # sequence length >= this; below it XLA's
                                   # fused dense attention wins on measured
@@ -96,6 +105,14 @@ class BertConfig:
                                   # scores stop fitting in VMEM-friendly
                                   # tiles).  0 = always engage (kernel
                                   # A/B measurement arms)
+
+    def __post_init__(self):
+        # a misspelled value ("rotary", "Rope") would silently fall back
+        # to learned positions at one site and skip rotation at another;
+        # fail at construction instead
+        if self.pos_kind not in ("learned", "rope"):
+            raise ValueError(f"pos_kind must be 'learned' or 'rope', "
+                             f"got {self.pos_kind!r}")
 
     @property
     def head_dim(self) -> int:
@@ -143,6 +160,25 @@ def ce_capacity(cfg, S: int) -> int:
     and the pipelined 1F1B microbatch loss — the schedules' loss parity
     depends on both computing the identical cap."""
     return min(S, max(8, -(-int(cfg.ce_capacity_frac * S) // 8) * 8))
+
+
+def rope(x, positions, base: float = 10000.0):
+    """Rotary position embedding: rotate each (even, odd-half) feature
+    pair of ``x`` (B, H, S, D) by an angle proportional to its ABSOLUTE
+    position, so dot products depend only on RELATIVE offsets
+    (rope(q,p1)·rope(k,p2) == rope(q,p1+d)·rope(k,p2+d) — pinned by
+    test).  ``positions``: (S,) int/float absolute positions.  Angles in
+    fp32, output in x.dtype; D must be even."""
+    D = x.shape[-1]
+    half = D // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = jnp.asarray(positions, jnp.float32)[:, None] * freqs[None]
+    cos = jnp.cos(ang)[None, None]                  # (1, 1, S, half)
+    sin = jnp.sin(ang)[None, None]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    return jnp.concatenate([x1 * cos - x2 * sin,
+                            x1 * sin + x2 * cos], -1).astype(x.dtype)
 
 
 def remat_policy_fn(cfg):
@@ -375,7 +411,9 @@ class BertMlm:
         """Encoder returning ``(hidden, summed aux loss)``."""
         c = self.cfg
         B, S = tokens.shape
-        h = params["tok_emb"][tokens] + params["pos_emb"][None, :S]
+        h = params["tok_emb"][tokens]
+        if c.pos_kind != "rope":
+            h = h + params["pos_emb"][None, :S]
         h = _layernorm(h, params["emb_ln"])
         if train and c.dropout > 0.0:
             if rng is None:
@@ -412,6 +450,11 @@ class BertMlm:
         def layer(h, lp, keys, mlp_fn):
             # --- attention (column-parallel QKV, row-parallel out) ---
             q, k, v = qkv_proj(lp, h, dt, fused=c.fused_qkv)
+            if c.pos_kind == "rope":
+                # before the attention dispatch AND before shard_map, so
+                # every impl (dense/flash/ring/Ulysses) sees rotated q/k
+                pos = jnp.arange(q.shape[2])
+                q, k = rope(q, pos), rope(k, pos)
             q = self._constrain(q, ("batch", "heads", "seq", "head_dim"))
             k = self._constrain(k, ("batch", "heads", "seq", "head_dim"))
             v = self._constrain(v, ("batch", "heads", "seq", "head_dim"))

@@ -122,6 +122,17 @@ class PipelinedBertMlm(bert_lib.BertMlm):
     def _num_stages(self) -> int:
         return self.mesh.shape.get("pipe", 1) if self.mesh is not None else 1
 
+    def __post_init__(self):
+        if self.cfg.pos_kind != "learned":
+            # the pipelined stage fn replicates the plain layer math
+            # WITHOUT the rope rotation; guarding at CONSTRUCTION covers
+            # every entry point (incl. checkpoint restore that skips
+            # init()) — failing loudly beats training a silently
+            # position-blind model
+            raise ValueError(
+                f"pipelined BERT supports pos_kind='learned' only "
+                f"(got {self.cfg.pos_kind!r})")
+
     def init(self, rng):
         params = super().init(rng)
         params["layers"] = stack_layers(params["layers"], self._num_stages)

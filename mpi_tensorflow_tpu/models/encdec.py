@@ -56,6 +56,16 @@ class EncDecLm:
 
     # ---------------- init ----------------
 
+    def __post_init__(self):
+        if self.cfg.pos_kind != "learned":
+            # the decoder embeds learned positions; mixing a rope encoder
+            # with a learned decoder would be a silent semantic fork —
+            # guard at construction so checkpoint-restore paths that skip
+            # init() are covered too
+            raise ValueError(
+                f"the encoder-decoder family supports pos_kind='learned' "
+                f"only (got {self.cfg.pos_kind!r})")
+
     def init(self, rng):
         c = self.cfg
         # key budget: 3 embeddings + 6 per encoder layer + 10 per decoder

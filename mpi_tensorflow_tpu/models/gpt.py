@@ -64,9 +64,10 @@ class CausalLm(bert_lib.BertMlm):
 
     def init_cache(self, batch_size: int, max_len: int) -> list:
         """Per-layer K/V buffers (zeros).  ``max_len`` caps prompt+output;
-        must be <= cfg.max_positions (position embeddings)."""
+        under learned positions it must fit the pos_emb table — rope has
+        no table and decodes to any length."""
         c = self.cfg
-        if max_len > c.max_positions:
+        if c.pos_kind == "learned" and max_len > c.max_positions:
             raise ValueError(
                 f"max_len {max_len} exceeds max_positions {c.max_positions}")
         z = jnp.zeros((batch_size, c.heads, max_len, c.head_dim), c.dtype)
@@ -95,9 +96,12 @@ class CausalLm(bert_lib.BertMlm):
         L = cache[0]["k"].shape[2]
         offset = jnp.asarray(offset, jnp.int32)
 
-        pos_emb = lax.dynamic_slice(
-            params["pos_emb"], (offset, 0), (S_in, c.hidden))
-        h = params["tok_emb"][tokens] + pos_emb[None]
+        if c.pos_kind == "rope":
+            h = params["tok_emb"][tokens]
+        else:
+            pos_emb = lax.dynamic_slice(
+                params["pos_emb"], (offset, 0), (S_in, c.hidden))
+            h = params["tok_emb"][tokens] + pos_emb[None]
         h = _layernorm(h, params["emb_ln"]).astype(dt)
         h = self._constrain(h, ("batch", "seq", "embed"))
 
@@ -112,6 +116,11 @@ class CausalLm(bert_lib.BertMlm):
         new_cache = []
         for lp, cc in zip(params["layers"], cache):
             q, k, v = bert_lib.qkv_proj(lp, h, dt, fused=c.fused_qkv)
+            if c.pos_kind == "rope":
+                # rotate at ABSOLUTE positions; keys enter the cache
+                # already rotated, so cached entries never re-rotate
+                q = bert_lib.rope(q, pos)
+                k = bert_lib.rope(k, pos)
             q = self._constrain(q, qkv_axes)
             ck = lax.dynamic_update_slice(cc["k"], k, (0, 0, offset, 0))
             cv = lax.dynamic_update_slice(cc["v"], v, (0, 0, offset, 0))

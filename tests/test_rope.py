@@ -1,0 +1,147 @@
+"""Rotary position embeddings (BertConfig.pos_kind='rope').
+
+The rotation is applied to q/k right before the attention dispatch, so
+dense/flash/ring/Ulysses and the KV-cache decode all inherit it.  These
+tests pin the defining property (dot products depend only on RELATIVE
+offset), the incremental-decode parity (cached keys rotated once at
+their absolute position), and the loud guards on the unported paths.
+"""
+
+import dataclasses as dc
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.models import bert, gpt
+
+pytestmark = pytest.mark.quick
+
+ROPE_TINY = dc.replace(bert.BERT_TINY, pos_kind="rope")
+
+
+def test_dot_products_are_relative():
+    """rope(q,p1)·rope(k,p2) must equal rope(q,p1+d)·rope(k,p2+d) —
+    absolute positions cancel, only the offset survives."""
+    r = np.random.default_rng(0)
+    q = jnp.asarray(r.normal(size=(1, 1, 1, 16)), jnp.float32)
+    k = jnp.asarray(r.normal(size=(1, 1, 1, 16)), jnp.float32)
+
+    def dot(p1, p2):
+        qr = bert.rope(q, jnp.asarray([p1]))
+        kr = bert.rope(k, jnp.asarray([p2]))
+        return float(jnp.sum(qr * kr))
+
+    for d in (1, 7, 100):
+        np.testing.assert_allclose(dot(3, 11), dot(3 + d, 11 + d),
+                                   rtol=1e-5)
+    # and the rotation is NOT a no-op: different offsets differ
+    assert abs(dot(3, 11) - dot(3, 12)) > 1e-4
+
+
+def test_rope_preserves_norm():
+    """A rotation never changes vector length (per feature pair)."""
+    x = jnp.asarray(np.random.default_rng(1).normal(size=(2, 3, 5, 8)),
+                    jnp.float32)
+    rx = bert.rope(x, jnp.arange(5) + 17)
+    np.testing.assert_allclose(np.linalg.norm(np.asarray(rx), axis=-1),
+                               np.linalg.norm(np.asarray(x), axis=-1),
+                               rtol=1e-5)
+
+
+def test_bert_mlm_trains_under_rope():
+    model = bert.BertMlm(dc.replace(ROPE_TINY, dropout=0.1))
+    params = model.init(jax.random.key(0))
+    r = np.random.default_rng(0)
+    toks = jnp.asarray(r.integers(0, ROPE_TINY.vocab_size, (2, 32)),
+                       jnp.int32)
+    batch = {"tokens": toks, "mask": jnp.asarray(r.random((2, 32)) < 0.25)}
+    loss, _ = model.loss(params, None, batch, toks,
+                         rng=jax.random.key(1), train=True)
+    assert np.isfinite(float(loss))
+    g = jax.grad(lambda p: model.loss(p, None, batch, toks,
+                                      rng=jax.random.key(1),
+                                      train=True)[0])(params)
+    # positions now flow through rotation, not the table: pos_emb gets no
+    # gradient, the token embedding still does
+    assert float(jnp.abs(g["pos_emb"]).sum()) == 0.0
+    assert float(jnp.abs(g["tok_emb"]).sum()) > 0.0
+
+
+def test_position_sensitivity_without_table():
+    """Swapping two tokens must change the logits elsewhere — position
+    information flows through the rotation alone."""
+    model = gpt.CausalLm(ROPE_TINY)
+    params = model.init(jax.random.key(0))
+    toks = jnp.asarray([[5, 9, 13, 21, 34, 55, 89, 144]], jnp.int32)
+    swapped = toks.at[0, 1].set(13).at[0, 2].set(9)
+    la = np.asarray(model.apply(params, toks))
+    lb = np.asarray(model.apply(params, swapped))
+    # the last position sees the same SET of tokens either way; only
+    # their positions moved — rope must make the logits differ
+    assert not np.allclose(la[0, -1], lb[0, -1])
+
+
+class TestRopeDecode:
+    def _setup(self):
+        model = gpt.CausalLm(ROPE_TINY)
+        params = model.init(jax.random.key(0))
+        toks = jnp.asarray(np.random.default_rng(3).integers(
+            0, ROPE_TINY.vocab_size, (2, 8)), jnp.int32)
+        return model, params, toks
+
+    def test_incremental_matches_full_at_every_step(self):
+        """KV-cache decode under rope: cached keys are rotated once at
+        their absolute position; greedy tokens must equal the full
+        teacher-forced forward at every step."""
+        model, params, toks = self._setup()
+        gen = np.asarray(jax.jit(
+            lambda p, t: model.generate(p, t, 6))(params, toks))
+        cur = np.asarray(toks)
+        for t in range(6):
+            logits = np.asarray(model.apply(params, jnp.asarray(cur)))
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            np.testing.assert_array_equal(gen[:, 8 + t], nxt,
+                                          err_msg=f"token {t}")
+            cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+    def test_beam_search_runs_under_rope(self):
+        model, params, toks = self._setup()
+        seqs, scores = model.beam_search(params, toks, 4, num_beams=2)
+        assert seqs.shape == (2, 2, 12)
+        assert np.isfinite(np.asarray(scores)).all()
+
+
+def test_unported_paths_fail_loudly_at_construction():
+    """The guards live in __post_init__, so even a checkpoint-restore
+    path that skips init() cannot build a position-corrupted model."""
+    from mpi_tensorflow_tpu.models import bert_pipeline, encdec
+    from mpi_tensorflow_tpu.parallel import mesh as meshlib
+
+    with pytest.raises(ValueError, match="pos_kind"):
+        encdec.EncDecLm(ROPE_TINY)
+    mesh = meshlib.make_mesh({"pipe": 2, "data": 4})
+    with pytest.raises(ValueError, match="pos_kind"):
+        bert_pipeline.PipelinedBertMlm(
+            dc.replace(ROPE_TINY, layers=2), mesh=mesh,
+            num_microbatches=2)
+
+
+def test_misspelled_pos_kind_rejected_at_config():
+    with pytest.raises(ValueError, match="pos_kind"):
+        dc.replace(bert.BERT_TINY, pos_kind="rotary")
+
+
+def test_rope_decodes_past_max_positions():
+    """rope has no position table: the KV cache may exceed
+    cfg.max_positions (the learned path keeps its cap)."""
+    model = gpt.CausalLm(dc.replace(ROPE_TINY, max_positions=16))
+    params = model.init(jax.random.key(0))
+    toks = jnp.asarray(np.random.default_rng(5).integers(
+        0, ROPE_TINY.vocab_size, (1, 12)), jnp.int32)
+    out = model.generate(params, toks, 10)      # 22 > max_positions
+    assert out.shape == (1, 22)
+    learned = gpt.CausalLm(dc.replace(bert.BERT_TINY, max_positions=16))
+    with pytest.raises(ValueError, match="max_positions"):
+        learned.init_cache(1, 22)
