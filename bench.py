@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 BASELINE_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -363,12 +364,25 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
     short_sec = median_time(lambda: gen_short(params, prompt))
     long_sec = median_time(lambda: gen_long(params, prompt))
     per_tok = (long_sec - short_sec) / new_tokens
-    degenerate = per_tok <= 0    # a tenancy stall ordered the arms backwards
+    # roofline sanity (VERDICT r3 #3): each decode step streams every live
+    # parameter from HBM at least once, so per-token time cannot beat
+    # param_bytes / HBM_bw on the real chip.  A slope below that bound is a
+    # measurement artifact (tenancy stall ordering the arms, tunnel noise)
+    # and must be flagged degenerate — never recorded as a throughput.
+    from mpi_tensorflow_tpu.utils import flops as flops_lib
+
+    param_bytes = sum(x.size * x.dtype.itemsize
+                      for x in jax.tree.leaves(params))
+    on_tpu = jax.devices()[0].platform == "tpu"
+    min_per_tok = param_bytes / (flops_lib.HBM_GBPS * 1e9) if on_tpu else 0.0
+    degenerate = per_tok <= min_per_tok
     return {
         "model": "gpt_base",
         "decode_tokens_per_sec": (batch_size / per_tok if not degenerate
                                   else float("nan")),
         "per_token_ms": per_tok * 1e3,
+        "roofline_min_per_token_ms": min_per_tok * 1e3,
+        "param_bytes": param_bytes,
         "timing_degenerate": degenerate,
         "decode_lengths": [n_short, n_long],
         "gen_short_ms": short_sec * 1e3,
@@ -383,7 +397,8 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
     }
 
 
-def measure_allreduce(payload_mb: float = 25.4, iters: int = 50) -> dict:
+def measure_allreduce(payload_mb: float = 25.4, iters: int = 50,
+                      chain: int = 32, dispatches: int = 7) -> dict:
     """Gradient-allreduce step time — the second half of the north-star
     metric ('allreduce step-time vs MPI baseline', BASELINE.json).
 
@@ -393,12 +408,25 @@ def measure_allreduce(payload_mb: float = 25.4, iters: int = 50) -> dict:
     unless overridden.  The MPI analogue is the reference's per-sync
     ``Gather`` of the four weight tensors (mpipy.py:121-127) — which is not
     even an allreduce; we time the honest collective.
+
+    Method (tunnel-robust, VERDICT r3 #6): ``chain`` data-dependent psums
+    run inside ONE compiled ``lax.scan`` dispatch, so per-dispatch host/
+    tunnel overhead (~ms over the axon tunnel — the source of the round-3
+    1.64 ms reading vs round 1's 0.086 ms for the same payload) amortizes
+    to chain⁻¹ of itself; the median over ``dispatches`` dispatches resists
+    the shared chip's tenancy stalls.  The data dependency (each iteration
+    rescales the previous psum's output) keeps XLA from eliding repeats.
+    ``iters`` is accepted for CLI compatibility and folded into
+    ``dispatches`` when larger.
     """
+    import time as _time
+
     import jax
+    import jax.numpy as jnp
     import numpy as np
+    from jax import lax
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
-    from mpi_tensorflow_tpu.utils.timing import time_fn
 
     mesh = meshlib.make_mesh()
     n = meshlib.data_axis_size(mesh)
@@ -411,18 +439,36 @@ def measure_allreduce(payload_mb: float = 25.4, iters: int = 50) -> dict:
 
     from mpi_tensorflow_tpu.parallel import collectives
 
-    @jax.jit
-    def allreduce(v):
-        return jax.shard_map(
-            lambda s: collectives.allreduce_sum(s, axis="data"), mesh=mesh,
-            in_specs=P("data"), out_specs=P(None),
-            check_vma=False)(v)
+    scale = jnp.float32(1.0 / n)
 
-    sec = time_fn(allreduce, x, iters=iters, warmup=5)
+    @jax.jit
+    def chained(v):
+        def shard_body(s):
+            def body(c, _):
+                # psum then rescale: keeps magnitudes stable across the
+                # chain and makes every iteration depend on the last
+                return collectives.allreduce_sum(c, axis="data") * scale, None
+
+            out, _ = lax.scan(body, s, None, length=chain)
+            return out
+
+        return jax.shard_map(shard_body, mesh=mesh, in_specs=P("data"),
+                             out_specs=P("data"), check_vma=False)(v)
+
+    dispatches = max(dispatches, iters // chain)
+    float(jnp.sum(chained(x)[0, :8]))      # compile + warmup, value-fetch sync
+    times = []
+    for _ in range(dispatches):
+        t0 = _time.perf_counter()
+        float(jnp.sum(chained(x)[0, :8]))  # value fetch = reliable sync
+        times.append(_time.perf_counter() - t0)
+    sec = sorted(times)[len(times) // 2] / chain
     return {
         "allreduce_ms": sec * 1e3,
         "payload_mb": payload_mb,
         "algbw_gbps": (payload_mb / 1e3) / sec if sec > 0 else float("inf"),
+        "chain": chain,
+        "dispatches": dispatches,
         "num_devices": n,
         "platform": jax.devices()[0].platform,
     }
@@ -484,6 +530,191 @@ def _backend_reachable(timeout_s: int = 180) -> bool:
 
 
 _PROBE_ERROR = ""
+
+_TRANSFORMER_MODELS = ("bert_base", "moe_bert", "gpt_base", "encdec_t5")
+_BERT_LABELS = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",
+                "gpt_base": "GPT-base causal LM",
+                "encdec_t5": "Encoder-decoder LM (cross-attention)"}
+MEASURE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "MEASURE_LOG.jsonl")
+
+
+def _stale_score(args, d: dict):
+    """Rank a MEASURE_LOG detail record as a stale stand-in for the
+    requested config: None = not usable, higher = closer config match."""
+    if args.mode == "decode":
+        v = d.get("decode_tokens_per_sec")
+        # the round-3 log carries one degenerate decode row (1.02e12
+        # tok/s, pre-dating the roofline guard) — a stale emit must never
+        # resurrect it, so apply the plausibility cap here too
+        if v is None or d.get("timing_degenerate") or not (0 < v < 1e6):
+            return None
+        if int(d.get("num_beams") or 0) != args.num_beams:
+            return None
+        return 1
+    if args.mode == "allreduce":
+        if d.get("allreduce_ms") is None:
+            return None
+        if abs(d.get("payload_mb", 0) - args.payload_mb) > 1e-6:
+            return None          # a different payload is a different metric
+        score = 1
+        if "chain" in d:     # the tunnel-robust (chained-scan) method
+            score += 1
+        return score
+    if d.get("model") != args.model:
+        return None
+    spec = MODEL_SPECS[args.model]
+    transformer = args.model in _TRANSFORMER_MODELS
+    key = ("tokens_per_sec_per_chip" if transformer
+           else "images_per_sec_per_chip")
+    if d.get(key) is None:
+        return None
+    # the full measured config must match EXACTLY — batch/precision/seq
+    # AND the variant levers (prng, fused_qkv, remat, params_bf16, ce,
+    # scan mode): a stale stand-in from a different config or an
+    # optimized-variant arm is a wrong number under the requested metric,
+    # the same failure class the roofline guard exists to eliminate — no
+    # record for this config means no stale fallback.  Absent keys on old
+    # records read as the defaults they were measured with.
+    want_b = args.batch_size if args.batch_size is not None else spec["batch"]
+    if d.get("batch_size_per_chip") != want_b:
+        return None
+    if d.get("precision") != args.precision:
+        return None
+    if bool(d.get("remat")) != bool(getattr(args, "remat", False)):
+        return None
+    scan_arg = getattr(args, "scan_steps", None)
+    want_scan = scan_arg if scan_arg is not None else spec["scan"]
+    if (d.get("scan_steps", 0) > 0) != (want_scan > 0):
+        return None          # device-throughput vs per-dispatch numbers
+    if transformer:
+        want_s = args.seq_len if args.seq_len is not None else spec["seq"]
+        if d.get("seq_len", 128) != want_s:
+            return None
+        if d.get("prng_impl", "threefry") != getattr(args, "prng",
+                                                     "threefry"):
+            return None
+        if bool(d.get("fused_qkv")) != bool(getattr(args, "fused_qkv",
+                                                    False)):
+            return None
+        if bool(d.get("params_bf16")) != bool(getattr(args, "params_bf16",
+                                                      False)):
+            return None
+        if d.get("ce_impl", "auto") != getattr(args, "ce", "auto"):
+            return None
+        want_f = getattr(args, "flash_min_seq", None)
+        if want_f is not None and d.get("flash_min_seq") != want_f:
+            return None
+        if want_f is None and d.get("flash_min_seq") in (0, 1 << 30):
+            return None      # kernel A/B override arms are not the default
+    return 1
+
+
+def _emit_stale(args):
+    """Tunnel-proof fallback (VERDICT r3 #1): when the accelerator probe
+    fails, emit the most recent real-TPU measurement for the requested
+    config from MEASURE_LOG.jsonl — marked ``stale`` with the original
+    (approximate) timestamp and the live-probe error — and exit 0, so the
+    driver artifact carries a real number regardless of tunnel state.
+    Returns 0 after emitting, None when no usable record exists."""
+    if not os.path.exists(MEASURE_LOG):
+        return None
+    watch_ts = None
+    best = None          # (score, line_idx, record)
+    with open(MEASURE_LOG) as f:
+        for idx, line in enumerate(f):
+            line = line.strip()
+            if not line:
+                continue
+            if line.startswith("#"):
+                # watcher comment lines carry the only timestamps the
+                # round-3 records have; the nearest preceding one bounds
+                # the record's age
+                m = re.search(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z", line)
+                if m:
+                    watch_ts = m.group(0)
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            d = rec.get("detail") or {}
+            if d.get("platform") != "tpu":
+                continue
+            score = _stale_score(args, d)
+            if score is None:
+                continue
+            rec["_near_ts"] = rec.get("ts") or watch_ts
+            if best is None or (score, idx) > (best[0], best[1]):
+                best = (score, idx, rec)
+    if best is None:
+        return None
+    _, _, rec = best
+    d = dict(rec.get("detail") or {})
+    d.update(stale=True,
+             stale_reason=f"accelerator backend unreachable: {_PROBE_ERROR}",
+             recorded_near_utc=rec.get("_near_ts"),
+             source_item=rec.get("item"), source="MEASURE_LOG.jsonl")
+    if args.mode == "decode":
+        kind = (f"beam-{args.num_beams}" if args.num_beams > 0 else "greedy")
+        _print_json({
+            "metric": f"GPT-base {kind} decode throughput (KV cache) "
+                      "[stale: last recorded TPU measurement]",
+            "value": round(d["decode_tokens_per_sec"], 1),
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "detail": d,
+        })
+        return 0
+    if args.mode == "allreduce":
+        base = _load_baseline()
+        vs = None
+        if base.get("allreduce", {}).get("allreduce_ms"):
+            vs = round(base["allreduce"]["allreduce_ms"] / d["allreduce_ms"],
+                       3)
+        _print_json({
+            "metric": "gradient allreduce step time "
+                      "[stale: last recorded TPU measurement]",
+            "value": round(d["allreduce_ms"], 3),
+            "unit": "ms",
+            "vs_baseline": vs,
+            "detail": d,
+        })
+        return 0
+    if args.model in _TRANSFORMER_MODELS:
+        label = _BERT_LABELS.get(args.model, "BERT-base MLM")
+        _print_json({
+            "metric": f"{label} train-step throughput "
+                      "(GSPMD, eval off timed path) "
+                      "[stale: last recorded TPU measurement]",
+            "value": round(d["tokens_per_sec_per_chip"], 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,
+            "detail": d,
+        })
+        return 0
+    base = _load_baseline()
+    vs = float("nan")
+    if args.model == "mnist_cnn" and base.get("images_per_sec_per_chip"):
+        # same comparability rule as the live path: cross-platform is the
+        # north-star comparison; within one platform, scan-mode numbers
+        # only compare to scan-mode numbers
+        same_platform = base.get("platform") == d.get("platform")
+        same_mode = (base.get("scan_steps", 0) > 0) == \
+            (d.get("scan_steps", 0) > 0)
+        if not same_platform or same_mode:
+            vs = (d["images_per_sec_per_chip"]
+                  / base["images_per_sec_per_chip"])
+    _print_json({
+        "metric": f"{IMAGE_MODEL_NAMES[args.model]} train-step throughput "
+                  "(eval off timed path) "
+                  "[stale: last recorded TPU measurement]",
+        "value": round(d["images_per_sec_per_chip"], 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3) if vs == vs else None,
+        "detail": d,
+    })
+    return 0
 
 
 def main(argv=None) -> int:
@@ -596,7 +827,17 @@ def main(argv=None) -> int:
                  "train mode only — other paths would silently ignore it")
 
     if not _backend_reachable():
-        # one parseable line beats an unbounded hang for whoever runs this
+        # degrade to the last recorded TPU measurement for this config,
+        # marked stale (VERDICT r3 #1) — the driver artifact must carry a
+        # real number even when the tunnel is down.  NEVER for
+        # --record-baseline: it must actually measure or fail (exit 1),
+        # or a wrapper would believe the baseline file was rewritten.
+        if not args.record_baseline:
+            rc = _emit_stale(args)
+            if rc is not None:
+                return rc
+        # no recorded measurement either: one parseable error line beats
+        # an unbounded hang for whoever runs this
         _print_json({
             "metric": "benchmark unavailable",
             "value": 0,
@@ -688,10 +929,7 @@ def main(argv=None) -> int:
                               prng_impl=args.prng, fused_qkv=args.fused_qkv,
                               flash_min_seq=args.flash_min_seq,
                               remat_policy=args.remat_policy)
-        label = {"moe_bert": "MoE-BERT MLM (capacity-routed EP)",
-                 "gpt_base": "GPT-base causal LM",
-                 "encdec_t5": "Encoder-decoder LM (cross-attention)"} \
-            .get(args.model, "BERT-base MLM")
+        label = _BERT_LABELS.get(args.model, "BERT-base MLM")
         _print_json({
             "metric": f"{label} train-step throughput "
                       "(GSPMD, eval off timed path)",

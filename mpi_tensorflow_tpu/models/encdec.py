@@ -140,7 +140,16 @@ class EncDecLm:
         c = self.cfg
         S = src.shape[1]
         h = params["tok_emb"][src] + params["pos_emb"][None, :S]
-        h = _layernorm(h, params["emb_ln"]).astype(c.dtype)
+        h = _layernorm(h, params["emb_ln"])
+        # embedding-site dropout on stream index 1, exactly as BertMlm
+        # applies it (ADVICE r3: this site was silently skipped, quietly
+        # diverging the family's regularization from its siblings)
+        if train and c.dropout > 0.0:
+            if rng is None:
+                raise ValueError("dropout needs an rng in train mode")
+            h = bert_lib.dropout_mask(h, c.dropout,
+                                      jax.random.fold_in(rng, 1))
+        h = h.astype(c.dtype)
         enc = self._encoder()
         h, _ = enc._run_layers({"layers": params["layers"]}, h,
                                train=train, rng=rng, drop_start=1)
@@ -200,14 +209,15 @@ class EncDecLm:
 
     def _dec_drop(self, li: int, train: bool, rng):
         """Decoder dropout hook for layer ``li``: stream indices continue
-        AFTER the encoder's (which consumes 1 + 2*enc_layers), 3 sites
-        per decoder layer — disjoint fold_in keys across the model."""
+        AFTER the encoder's (embed site 1 + 2 per encoder layer) and the
+        decoder-embed site (index 2 + 2*enc_layers), 3 sites per decoder
+        layer — disjoint fold_in keys across the model."""
         c = self.cfg
         if not train or c.dropout == 0.0:
             return None
         if rng is None:
             raise ValueError("dropout needs an rng in train mode")
-        base = 2 + 2 * c.layers + 3 * li
+        base = 3 + 2 * c.layers + 3 * li
 
         def drop(site, x):
             return bert_lib.dropout_mask(
@@ -218,8 +228,18 @@ class EncDecLm:
                       train: bool = False, rng=None):
         """Teacher-forced decoder pass -> hidden states (B, T, E) in the
         compute dtype (the input to the tied vocab head)."""
-        dt = self.cfg.dtype
+        c = self.cfg
+        dt = c.dtype
         h = self._dec_embed(params, tgt_in)
+        # decoder embedding-site dropout on the reserved stream index
+        # right after the encoder's (see _dec_drop); generate() never
+        # trains, so the site lives here rather than in _dec_embed
+        if train and c.dropout > 0.0:
+            if rng is None:
+                raise ValueError("dropout needs an rng in train mode")
+            h = bert_lib.dropout_mask(
+                h, c.dropout,
+                jax.random.fold_in(rng, 2 + 2 * c.layers)).astype(dt)
         xkvs = self._cross_kv(params, enc_out)
         attn = self._dec_self_attn_impl()
 
@@ -307,6 +327,14 @@ class EncDecLm:
         if max_new_tokens < 1:
             raise ValueError("generate needs max_new_tokens >= 1")
         c = self.cfg
+        if max_new_tokens > c.max_positions:
+            # _dec_embed's dynamic_slice clamps its start index, so
+            # decoding past the learned dec_pos_emb table would silently
+            # reuse the last row's embedding — mirror CausalLm.init_cache
+            # and raise instead (ADVICE r3)
+            raise ValueError(
+                f"max_new_tokens {max_new_tokens} exceeds max_positions "
+                f"{c.max_positions}")
         dt = c.dtype
         B = src.shape[0]
         L = max_new_tokens

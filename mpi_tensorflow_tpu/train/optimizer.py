@@ -117,7 +117,11 @@ def warmup_cosine(base_lr: float, warmup_steps: int, total_steps: int,
     return schedule
 
 
-_BIAS_NAME = __import__("re").compile(r"^(b[a-z0-9]?|eb\d)$")
+# x? prefix: the enc-dec family's cross-attention biases (xbq/xbk/xbv/xbo,
+# models/encdec.py) are 2-D (heads, head_dim), so the ndim guard does not
+# exclude them either — without the prefix they silently weight-decayed
+# (ADVICE r3 medium)
+_BIAS_NAME = __import__("re").compile(r"^x?(b[a-z0-9]?|eb\d)$")
 
 
 def _leaf_name(path) -> str:
@@ -133,12 +137,14 @@ def decay_mask(params):
     """The BERT-recipe weight-decay mask: decay weight matrices, skip
     LayerNorm scales/biases and every bias — by NAME, not just ndim,
     because the MoE family's per-expert biases (``eb1``: (E, mlp),
-    ``eb2``: (E, hidden)) are 2-D and a structural rule would silently
-    decay them.  Bias-like names across the families: ``b``/``bq``/
-    ``bk``/``bv``/``bo``/``b1``/``b2``, ``eb1``/``eb2``, ``*_b``
-    (``out_b``, ``patch_b``, ``head_b``), and the ``scale``/``bias``
-    LayerNorm leaves.  Decaying norms/biases is a silent recipe
-    deviation that costs convergence at scale."""
+    ``eb2``: (E, hidden)) and the enc-dec family's cross-attention biases
+    (``xbq``/``xbk``/``xbv``: (heads, head_dim)) are 2-D and a structural
+    rule would silently decay them.  Bias-like names across the families:
+    ``b``/``bq``/``bk``/``bv``/``bo``/``b1``/``b2``, ``eb1``/``eb2``,
+    ``xbq``/``xbk``/``xbv``/``xbo``, ``*_b`` (``out_b``, ``patch_b``,
+    ``head_b``), and the ``scale``/``bias`` LayerNorm leaves.
+    Decaying norms/biases is a silent recipe deviation that costs
+    convergence at scale."""
     def decayable(path, p):
         name = _leaf_name(path)
         if name in ("scale", "bias") or name.endswith("_b") \

@@ -23,6 +23,11 @@ from __future__ import annotations
 # TPU v5e (the measurement chip): 197 TFLOP/s bf16 peak per chip.
 PEAK_TFLOPS = {"bf16": 197.0, "fp32": 49.0}
 
+# v5e HBM bandwidth, GB/s — the roofline for bandwidth-bound paths
+# (autoregressive decode reads every live parameter once per token-step,
+# so tokens/sec is bounded by batch * HBM_GBPS / param_bytes).
+HBM_GBPS = 819.0
+
 # fwd-only GFLOPs per image at the bench input geometry (canonical
 # published MACs x 2).  fwd+bwd = 3x.
 _IMAGE_FWD_GFLOPS = {

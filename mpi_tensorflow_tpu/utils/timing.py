@@ -50,22 +50,6 @@ class StepTimer:
         return batch_size / s if s == s and s > 0 else float("nan")
 
 
-def time_fn(fn, *args, iters: int = 20, warmup: int = 3):
-    """Benchmark a jitted function: returns mean seconds/call, blocking on
-    outputs.  Donated-input functions must be passed arg factories instead —
-    see ``time_step_fn``."""
-    import jax
-
-    for _ in range(warmup):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
-
-
 def time_step_fn(step_fn, state, make_args, iters: int = 20, warmup: int = 3):
     """Benchmark a train step that donates (and returns) its state.
 

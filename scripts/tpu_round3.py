@@ -37,7 +37,10 @@ from mpi_tensorflow_tpu.utils.jsonsafe import json_safe  # noqa: E402
 
 
 def emit(obj):
-    # json_safe: NaN/Inf -> null, the repo's JSON-strictness rule
+    # json_safe: NaN/Inf -> null, the repo's JSON-strictness rule.
+    # ts: bench._emit_stale reports a record's age from this field (the
+    # round-3 rows only have the adjacent watcher lines to date them by)
+    obj = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()), **obj}
     line = json.dumps(json_safe(obj))
     print(line, flush=True)
     with open(LOG, "a") as f:
@@ -101,9 +104,11 @@ ITEMS = ["bert_diagnose", "bert_profile", "resnet_profile",
          "bert_rbg_fused", "bert_b128", "bert_b256",
          "bert_s2048_flash_remat", "bert_s2048_remat_dots",
          "bert_s4096_flash", "bert_s4096_xla",
+         "bert_s8192_flash", "bert_s8192_xla",
          "vit_b128", "resnet50_b32",
          "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
-         "gpt_base", "encdec_t5", "decode", "bert_s512", "bert_s2048",
+         "gpt_base", "encdec_t5", "decode", "decode_beam",
+         "bert_s512", "bert_s2048",
          "mnist",
          "resnet20", "allreduce", "bert_noflash", "bert_s2048_noflash"]
 
@@ -133,6 +138,23 @@ def main():
     run_item("bert_rbg_fused", lambda: bench.measure_bert(
         batch_size=64, steps=32, precision="bf16", scan_steps=4,
         prng_impl="rbg", fused_qkv=True))
+    # cheap + decisive, early in the window (VERDICT r3 #3/#6): the
+    # re-queued allreduce runs the tunnel-robust chained-scan method
+    # (reconciling the 19x r1-vs-r3 discrepancy); decode re-runs under
+    # the HBM-roofline guard; beam is the search-mode arm
+    run_item("allreduce", lambda: bench.measure_allreduce(iters=50))
+
+    def decode_item(num_beams=0):
+        d = bench.measure_decode(precision="bf16", num_beams=num_beams)
+        if d.get("timing_degenerate"):
+            # a tenancy stall ordered the timing arms backwards — raise
+            # so the flagged-useless number is recorded but NOT stamped
+            raise RuntimeError("degenerate decode timing "
+                               f"(slope <= roofline): {d}")
+        return d
+
+    run_item("decode", decode_item)
+    run_item("decode_beam", lambda: decode_item(num_beams=4))
     run_item("bert_b128", lambda: bench.measure_bert(
         batch_size=128, steps=16, precision="bf16", scan_steps=4))
     run_item("bert_b256", lambda: bench.measure_bert(
@@ -155,6 +177,16 @@ def main():
     run_item("bert_s4096_xla", lambda: bench.measure_bert(
         batch_size=2, steps=8, precision="bf16", scan_steps=2,
         seq_len=4096, remat=True, flash_min_seq=1 << 30))
+    # S=8192 endpoint (VERDICT r3 #4): the long-context regime where the
+    # Pallas kernel must earn its keep — XLA dense materializes
+    # (1,12,8192,8192) fp32 score blocks (3.2 GB/layer transient even
+    # under remat), flash streams them
+    run_item("bert_s8192_flash", lambda: bench.measure_bert(
+        batch_size=1, steps=6, precision="bf16", scan_steps=2,
+        seq_len=8192, remat=True, flash_min_seq=0))
+    run_item("bert_s8192_xla", lambda: bench.measure_bert(
+        batch_size=1, steps=6, precision="bf16", scan_steps=2,
+        seq_len=8192, remat=True, flash_min_seq=1 << 30))
     run_item("vit_b128", lambda: bench.measure(
         batch_size=128, steps=200, precision="bf16", scan_steps=20,
         model_name="vit"))
@@ -177,16 +209,6 @@ def main():
         batch_size=64, steps=32, precision="bf16", scan_steps=4,
         model_name="encdec_t5"))
 
-    def decode_item():
-        d = bench.measure_decode(precision="bf16")
-        if d.get("timing_degenerate"):
-            # a tenancy stall ordered the timing arms backwards — raise
-            # so the flagged-useless number is recorded but NOT stamped
-            raise RuntimeError("degenerate decode timing "
-                               f"(slope <= 0): {d}")
-        return d
-
-    run_item("decode", decode_item)
     # long-context flagship: S=512 and S=2048 — the regime the flash
     # fwd+bwd kernels target (attention is O(S^2); at S=128 it is noise)
     run_item("bert_s512", lambda: bench.measure_bert(
@@ -201,7 +223,6 @@ def main():
     run_item("resnet20", lambda: bench.measure(
         batch_size=128, steps=500, precision="fp32", scan_steps=50,
         model_name="resnet20"))
-    run_item("allreduce", lambda: bench.measure_allreduce(iters=50))
 
     # -- 3. the flash-vs-XLA control arm (env-var controlled, needs its own
     #    process: the disable flag is read at trace time but engagement

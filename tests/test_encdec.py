@@ -75,6 +75,39 @@ class TestForward:
         np.testing.assert_array_equal(np.asarray(m.apply(params, b)),
                                       np.asarray(m.apply(params, b)))
 
+    def test_dropout_fires_at_both_embedding_sites(self, monkeypatch):
+        """ADVICE r3: the encoder-embed mask (stream 1, as BertMlm applies
+        it) and a reserved decoder-embed site must both fire in train
+        mode.  Counted via the shared dropout_mask: 1 enc embed +
+        2/enc-layer + 1 dec embed + 3/dec-layer."""
+        calls = []
+        real = bert.dropout_mask
+
+        def counting(x, rate, key):
+            calls.append(x.shape)
+            return real(x, rate, key)
+
+        monkeypatch.setattr(bert, "dropout_mask", counting)
+        m = _model(dropout=0.1)
+        params = m.init(jax.random.key(0))
+        m.apply(params, _batch(), train=True, rng=jax.random.key(1))
+        expected = 1 + 2 * CFG.layers + 1 + 3 * m.n_dec
+        assert len(calls) == expected
+        m2 = _model(dropout=0.1)
+        calls.clear()
+        m2.apply(params, _batch())           # eval: no dropout anywhere
+        assert calls == []
+
+    def test_generate_rejects_beyond_position_table(self):
+        """ADVICE r3: _dec_embed's dynamic_slice clamps, so decoding past
+        dec_pos_emb would silently reuse the last row — must raise like
+        CausalLm.init_cache."""
+        m = _model()
+        params = m.init(jax.random.key(0))
+        src = _batch()["src"]
+        with pytest.raises(ValueError, match="max_positions"):
+            m.generate(params, src, CFG.max_positions + 1)
+
     def test_asymmetric_stacks(self):
         m = _model(dec_layers=1)
         params = m.init(jax.random.key(0))

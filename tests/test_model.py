@@ -180,17 +180,20 @@ class TestOptimizer:
 
         params = {"w": jnp.ones((3, 3)), "ln": {"scale": jnp.ones((3,))},
                   "b": jnp.ones((3,)),
-                  # MoE per-expert biases are 2-D — the mask must catch
+                  # MoE per-expert biases and enc-dec cross-attention
+                  # biases (xbq, ADVICE r3) are 2-D — the mask must catch
                   # them by NAME, a structural ndim rule would decay them
                   "eb1": jnp.ones((2, 3)), "out_b": jnp.ones((3,)),
-                  "layers": [{"bq": jnp.ones((2, 2))}]}
+                  "layers": [{"bq": jnp.ones((2, 2)),
+                              "xbq": jnp.ones((2, 2))}]}
         grads = jax.tree.map(jnp.zeros_like, params)
         tx = optimizer.transformer_tx(1.0, 10, schedule="constant",
                                       weight_decay=0.1, grad_clip_norm=0.0)
         upd, _ = tx.update(grads, tx.init(params), params)
         assert float(jnp.abs(upd["w"]).sum()) > 0        # decayed
         for leaf in (upd["b"], upd["ln"]["scale"], upd["eb1"],
-                     upd["out_b"], upd["layers"][0]["bq"]):
+                     upd["out_b"], upd["layers"][0]["bq"],
+                     upd["layers"][0]["xbq"]):
             assert float(jnp.abs(leaf).sum()) == 0       # not decayed
 
     def test_lamb_trust_ratio_scales_update_to_param_norm(self):
