@@ -296,6 +296,7 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
         "batch_size_per_chip": batch_size,
         "precision": precision,
         "scan_steps": scan_steps,
+        "remat": remat,
         "platform": jax.devices()[0].platform,
     }
 
@@ -539,9 +540,11 @@ MEASURE_LOG = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "MEASURE_LOG.jsonl")
 
 
-def _stale_score(args, d: dict):
+def _stale_score(args, d: dict, item=None):
     """Rank a MEASURE_LOG detail record as a stale stand-in for the
-    requested config: None = not usable, higher = closer config match."""
+    requested config: None = not usable, higher = closer config match.
+    ``item`` is the queue-item name the record landed under (used to
+    infer remat for legacy image rows that predate the ``remat`` key)."""
     if args.mode == "decode":
         v = d.get("decode_tokens_per_sec")
         # the round-3 log carries one degenerate decode row (1.02e12
@@ -550,6 +553,16 @@ def _stale_score(args, d: dict):
         if v is None or d.get("timing_degenerate") or not (0 < v < 1e6):
             return None
         if int(d.get("num_beams") or 0) != args.num_beams:
+            return None
+        # same exact-config rule as train mode: tok/s scales with batch,
+        # the slope with prompt/generation lengths and dtype
+        if d.get("batch_size") != (args.batch_size or 8):
+            return None
+        if d.get("precision") != args.precision:
+            return None
+        if d.get("prompt_len") != getattr(args, "prompt_len", 32):
+            return None
+        if d.get("new_tokens") != getattr(args, "new_tokens", 128):
             return None
         return 1
     if args.mode == "allreduce":
@@ -581,7 +594,10 @@ def _stale_score(args, d: dict):
         return None
     if d.get("precision") != args.precision:
         return None
-    if bool(d.get("remat")) != bool(getattr(args, "remat", False)):
+    # legacy image rows predate measure() recording ``remat``; their
+    # queue-item name (e.g. "resnet50_b128_remat") is the ground truth
+    rec_remat = d.get("remat", "remat" in (item or ""))
+    if bool(rec_remat) != bool(getattr(args, "remat", False)):
         return None
     scan_arg = getattr(args, "scan_steps", None)
     want_scan = scan_arg if scan_arg is not None else spec["scan"]
@@ -608,6 +624,75 @@ def _stale_score(args, d: dict):
         if want_f is None and d.get("flash_min_seq") in (0, 1 << 30):
             return None      # kernel A/B override arms are not the default
     return 1
+
+
+def _report(args, d: dict, stale: bool = False) -> int:
+    """THE metric-line emitter for every mode — shared by the live
+    measurement paths and the stale fallback, so the two can never
+    drift apart in labels, units, or comparability rules.  ``d`` is a
+    measure_*() result dict (for stale: the recorded detail, already
+    augmented with the stale provenance fields)."""
+    suffix = " [stale: last recorded TPU measurement]" if stale else ""
+    if args.mode == "decode":
+        kind = (f"beam-{args.num_beams}" if args.num_beams > 0 else "greedy")
+        v = d["decode_tokens_per_sec"]
+        _print_json({
+            "metric": f"GPT-base {kind} decode throughput "
+                      f"(KV cache){suffix}",
+            "value": round(v, 1) if v == v else None,   # NaN -> null
+            "unit": "tokens/sec",
+            "vs_baseline": None,
+            "detail": d,
+        })
+        return 0
+    if args.mode == "allreduce":
+        base = _load_baseline()
+        vs = None
+        if base.get("allreduce", {}).get("allreduce_ms"):
+            # >1 means faster than the recorded baseline (time ratio)
+            vs = round(base["allreduce"]["allreduce_ms"] / d["allreduce_ms"],
+                       3)
+        _print_json({
+            "metric": f"gradient allreduce step time{suffix}",
+            "value": round(d["allreduce_ms"], 3),
+            "unit": "ms",
+            "vs_baseline": vs,
+            "detail": d,
+        })
+        return 0
+    if args.model in _TRANSFORMER_MODELS:
+        label = _BERT_LABELS.get(args.model, "BERT-base MLM")
+        _print_json({
+            "metric": f"{label} train-step throughput "
+                      f"(GSPMD, eval off timed path){suffix}",
+            "value": round(d["tokens_per_sec_per_chip"], 1),
+            "unit": "tokens/sec/chip",
+            "vs_baseline": None,   # no recorded reference-semantics baseline
+            "detail": d,
+        })
+        return 0
+    base = _load_baseline()
+    vs = float("nan")
+    if args.model == "mnist_cnn" and base.get("images_per_sec_per_chip"):
+        # cross-platform (TPU build vs the CPU reference baseline) is the
+        # north-star comparison and always valid.  Within one platform,
+        # though, a scan-mode device-throughput number is not comparable
+        # to a per-dispatch (tunnel-latency-bound) one.
+        same_platform = base.get("platform") == d.get("platform")
+        same_mode = (base.get("scan_steps", 0) > 0) == \
+            (d.get("scan_steps", 0) > 0)
+        if not same_platform or same_mode:
+            vs = (d["images_per_sec_per_chip"]
+                  / base["images_per_sec_per_chip"])
+    _print_json({
+        "metric": f"{IMAGE_MODEL_NAMES[args.model]} train-step throughput "
+                  f"(eval off timed path){suffix}",
+        "value": round(d["images_per_sec_per_chip"], 1),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(vs, 3) if vs == vs else None,
+        "detail": d,
+    })
+    return 0
 
 
 def _emit_stale(args):
@@ -641,7 +726,7 @@ def _emit_stale(args):
             d = rec.get("detail") or {}
             if d.get("platform") != "tpu":
                 continue
-            score = _stale_score(args, d)
+            score = _stale_score(args, d, item=rec.get("item"))
             if score is None:
                 continue
             rec["_near_ts"] = rec.get("ts") or watch_ts
@@ -655,66 +740,7 @@ def _emit_stale(args):
              stale_reason=f"accelerator backend unreachable: {_PROBE_ERROR}",
              recorded_near_utc=rec.get("_near_ts"),
              source_item=rec.get("item"), source="MEASURE_LOG.jsonl")
-    if args.mode == "decode":
-        kind = (f"beam-{args.num_beams}" if args.num_beams > 0 else "greedy")
-        _print_json({
-            "metric": f"GPT-base {kind} decode throughput (KV cache) "
-                      "[stale: last recorded TPU measurement]",
-            "value": round(d["decode_tokens_per_sec"], 1),
-            "unit": "tokens/sec",
-            "vs_baseline": None,
-            "detail": d,
-        })
-        return 0
-    if args.mode == "allreduce":
-        base = _load_baseline()
-        vs = None
-        if base.get("allreduce", {}).get("allreduce_ms"):
-            vs = round(base["allreduce"]["allreduce_ms"] / d["allreduce_ms"],
-                       3)
-        _print_json({
-            "metric": "gradient allreduce step time "
-                      "[stale: last recorded TPU measurement]",
-            "value": round(d["allreduce_ms"], 3),
-            "unit": "ms",
-            "vs_baseline": vs,
-            "detail": d,
-        })
-        return 0
-    if args.model in _TRANSFORMER_MODELS:
-        label = _BERT_LABELS.get(args.model, "BERT-base MLM")
-        _print_json({
-            "metric": f"{label} train-step throughput "
-                      "(GSPMD, eval off timed path) "
-                      "[stale: last recorded TPU measurement]",
-            "value": round(d["tokens_per_sec_per_chip"], 1),
-            "unit": "tokens/sec/chip",
-            "vs_baseline": None,
-            "detail": d,
-        })
-        return 0
-    base = _load_baseline()
-    vs = float("nan")
-    if args.model == "mnist_cnn" and base.get("images_per_sec_per_chip"):
-        # same comparability rule as the live path: cross-platform is the
-        # north-star comparison; within one platform, scan-mode numbers
-        # only compare to scan-mode numbers
-        same_platform = base.get("platform") == d.get("platform")
-        same_mode = (base.get("scan_steps", 0) > 0) == \
-            (d.get("scan_steps", 0) > 0)
-        if not same_platform or same_mode:
-            vs = (d["images_per_sec_per_chip"]
-                  / base["images_per_sec_per_chip"])
-    _print_json({
-        "metric": f"{IMAGE_MODEL_NAMES[args.model]} train-step throughput "
-                  "(eval off timed path) "
-                  "[stale: last recorded TPU measurement]",
-        "value": round(d["images_per_sec_per_chip"], 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 3) if vs == vs else None,
-        "detail": d,
-    })
-    return 0
+    return _report(args, d, stale=True)
 
 
 def main(argv=None) -> int:
@@ -856,17 +882,7 @@ def main(argv=None) -> int:
                            precision=args.precision,
                            iters=max(1, (args.steps or 5)),
                            num_beams=args.num_beams)
-        v = r["decode_tokens_per_sec"]
-        kind = (f"beam-{args.num_beams}" if args.num_beams > 0
-                else "greedy")
-        _print_json({
-            "metric": f"GPT-base {kind} decode throughput (KV cache)",
-            "value": round(v, 1) if v == v else None,   # NaN -> null
-            "unit": "tokens/sec",
-            "vs_baseline": None,
-            "detail": r,
-        })
-        return 0
+        return _report(args, r)
 
     if args.mode == "allreduce":
         r = measure_allreduce(payload_mb=args.payload_mb,
@@ -874,20 +890,7 @@ def main(argv=None) -> int:
         if args.record_baseline:
             _record_baseline("allreduce", r)
             return 0
-        base = _load_baseline()
-        vs = None
-        if base.get("allreduce", {}).get("allreduce_ms"):
-            # >1 means faster than the recorded baseline (time ratio)
-            vs = round(base["allreduce"]["allreduce_ms"] / r["allreduce_ms"],
-                       3)
-        _print_json({
-            "metric": "gradient allreduce step time",
-            "value": round(r["allreduce_ms"], 3),
-            "unit": "ms",
-            "vs_baseline": vs,
-            "detail": r,
-        })
-        return 0
+        return _report(args, r)
 
     if args.record_baseline and args.precision != "fp32":
         # the recorded baseline is by definition the fp32 reference-semantics
@@ -929,16 +932,7 @@ def main(argv=None) -> int:
                               prng_impl=args.prng, fused_qkv=args.fused_qkv,
                               flash_min_seq=args.flash_min_seq,
                               remat_policy=args.remat_policy)
-        label = _BERT_LABELS.get(args.model, "BERT-base MLM")
-        _print_json({
-            "metric": f"{label} train-step throughput "
-                      "(GSPMD, eval off timed path)",
-            "value": round(result["tokens_per_sec_per_chip"], 1),
-            "unit": "tokens/sec/chip",
-            "vs_baseline": None,   # no recorded reference-semantics baseline
-            "detail": result,
-        })
-        return 0
+        return _report(args, result)
 
     result = measure(batch_size=batch, steps=steps,
                      precision=args.precision, scan_steps=scan,
@@ -948,29 +942,7 @@ def main(argv=None) -> int:
     if args.record_baseline:
         _record_baseline("train", result)
         return 0
-
-    base = _load_baseline()
-    vs = float("nan")
-    if args.model == "mnist_cnn" and base.get("images_per_sec_per_chip"):
-        # cross-platform (TPU build vs the CPU reference baseline) is the
-        # north-star comparison and always valid.  Within one platform,
-        # though, a scan-mode device-throughput number is not comparable to
-        # a per-dispatch (tunnel-latency-bound) one.
-        same_platform = base.get("platform") == result["platform"]
-        same_mode = (base.get("scan_steps", 0) > 0) == (result["scan_steps"] > 0)
-        if not same_platform or same_mode:
-            vs = (result["images_per_sec_per_chip"]
-                  / base["images_per_sec_per_chip"])
-
-    _print_json({
-        "metric": f"{IMAGE_MODEL_NAMES[args.model]} train-step throughput "
-                  "(eval off timed path)",
-        "value": round(result["images_per_sec_per_chip"], 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(vs, 3) if vs == vs else None,
-        "detail": result,
-    })
-    return 0
+    return _report(args, result)
 
 
 if __name__ == "__main__":

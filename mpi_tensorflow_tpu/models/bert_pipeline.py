@@ -176,8 +176,14 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         a = ring.dense_attention(q, k, v)
         a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
         h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)
-        m = bert_lib.gelu_mlp(lp, h, dt, reduce=reduce)
+        m = self._plain_mlp(lp, h, reduce)
         return _layernorm(h + dropout(m, 1), lp["ln2"]).astype(dt)
+
+    def _plain_mlp(self, lp, h, reduce):
+        """Stage-interior MLP hook — dense GELU here; the pipelined MoE
+        variant (models/moe.PipelinedMoeBertMlm) swaps in the routed
+        expert dispatch."""
+        return bert_lib.gelu_mlp(lp, h, self.cfg.dtype, reduce=reduce)
 
     def _dropping(self, train: bool, rng) -> bool:
         if not (train and self.cfg.dropout > 0.0):

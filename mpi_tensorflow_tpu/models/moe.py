@@ -31,6 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from mpi_tensorflow_tpu.models import bert as bert_lib
+from mpi_tensorflow_tpu.models import bert_pipeline
 from mpi_tensorflow_tpu.models.bert import _norm_init
 
 
@@ -189,3 +190,57 @@ class MoeBertMlm(bert_lib.BertMlm):
         logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
             + params["mlm"]["out_b"]
         return logits.astype(jnp.float32), aux
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedMoeBertMlm(bert_pipeline.PipelinedBertMlm, MoeBertMlm):
+    """MoE under pipeline parallelism: encoder stages pipelined over the
+    mesh's ``pipe`` axis (GPipe or 1F1B, bert_pipeline.PipelinedBertMlm),
+    each stage layer routing its MLP through the capacity-based expert
+    dispatch (MoeBertMlm._moe_mlp, run mesh-free inside the pipeline
+    shard_map).
+
+    Composition contract this round (guarded at construction):
+    - layers are UNIFORMLY MoE (``every_other=False``) — stage stacking
+      (bert_pipeline.stack_layers) needs homogeneous layer pytrees;
+    - experts live replicated within each stage (no ``expert`` mesh axis
+      under PP: the routed scatter/gather is token-local inside the pipe
+      shard_map, the EP all-to-all belongs to the non-pipelined path);
+    - no Megatron TP inside MoE stages (``model`` axis): the expert
+      weights' ``mlp`` logical axis would shard over it and the dispatch
+      has no row-parallel reduction yet;
+    - ``aux_loss_weight == 0`` — the load-balance aux term is not
+      threaded through the pipeline schedule; capacity routing still
+      bounds per-expert load (overflow drops), it is the balancing
+      *gradient* that is absent.
+    """
+    moe: MoeConfig = MoeConfig(every_other=False, aux_loss_weight=0.0)
+
+    def __post_init__(self):
+        super().__post_init__()          # pos_kind guard
+        if self.moe.every_other:
+            raise ValueError(
+                "pipelined MoE needs uniform expert layers "
+                "(MoeConfig(every_other=False)): stage stacking requires "
+                "homogeneous layer pytrees")
+        if self.moe.aux_loss_weight != 0.0:
+            raise ValueError(
+                "pipelined MoE does not thread the load-balance aux loss "
+                "through the pipeline schedule; set "
+                "MoeConfig(aux_loss_weight=0.0) explicitly rather than "
+                "silently dropping the term")
+        if self.mesh is not None:
+            for axis in ("expert", "model"):
+                if self.mesh.shape.get(axis, 1) > 1:
+                    raise ValueError(
+                        f"pipelined MoE supports pipe x data meshes only "
+                        f"this round (got {axis}="
+                        f"{self.mesh.shape[axis]}); drop the {axis!r} "
+                        f"axis rather than silently ignoring it")
+
+    def _plain_mlp(self, lp, h, reduce):
+        # inside the pipe shard_map GSPMD annotations are illegal — run
+        # the routed dispatch on a mesh-free view (same trick as the
+        # 1F1B head path); the aux term is guarded to weight 0 above
+        out, _aux = dataclasses.replace(self, mesh=None)._moe_mlp(h, lp)
+        return out

@@ -60,7 +60,23 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     if config.model == "moe_bert":
         from mpi_tensorflow_tpu.models import moe
 
-        model = moe.MoeBertMlm(bert_cfg, mesh=mesh)
+        if mesh.shape.get("pipe", 1) > 1:
+            # MoE under PP: uniform expert layers pipelined over the pipe
+            # axis (the plain MoeBertMlm would silently ignore the axis).
+            # Architecturally DIFFERENT from the data-mesh default — say
+            # so loudly: checkpoints and convergence numbers are not
+            # comparable across the two meshes.
+            print("[mlm_loop] moe_bert under a pipe mesh uses "
+                  "PipelinedMoeBertMlm: every layer is MoE "
+                  "(every_other=False) and the load-balance aux loss is "
+                  "off — a different architecture from the data-mesh "
+                  "default (MoE on odd layers, aux 0.01); checkpoints/"
+                  "traces are not interchangeable between the two",
+                  flush=True)
+            model = moe.PipelinedMoeBertMlm(
+                bert_cfg, mesh=mesh, schedule=config.pp_schedule)
+        else:
+            model = moe.MoeBertMlm(bert_cfg, mesh=mesh)
     elif config.model == "gpt_base":
         from mpi_tensorflow_tpu.models import gpt
 

@@ -214,6 +214,49 @@ class TestStaleFallback:
                             str(tmp_path / "missing.jsonl"))
         assert bench._emit_stale(self._args()) is None
 
+    def test_legacy_remat_rows_matched_by_item_name(self, tmp_path,
+                                                    monkeypatch, capsys):
+        """Image rows recorded before measure() carried a 'remat' key are
+        classified by their queue-item name: a *_remat row answers only
+        --remat requests."""
+        import json
+
+        self._write_log(tmp_path, monkeypatch, [
+            json.dumps({"item": "resnet50_b128_remat", "detail": {
+                "model": "resnet50", "platform": "tpu", "precision": "bf16",
+                "batch_size_per_chip": 128, "scan_steps": 8,
+                "images_per_sec_per_chip": 1616.6}}),
+        ])
+        args_plain = self._args(model="resnet50", precision="bf16",
+                                batch_size=128)
+        assert bench._emit_stale(args_plain) is None
+        args_remat = self._args(model="resnet50", precision="bf16",
+                                batch_size=128, remat=True)
+        assert bench._emit_stale(args_remat) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["value"] == 1616.6
+
+    def test_decode_requires_exact_config(self, tmp_path, monkeypatch,
+                                          capsys):
+        import json
+
+        self._write_log(tmp_path, monkeypatch, [
+            json.dumps({"item": "decode", "detail": {
+                "model": "gpt_base", "platform": "tpu", "precision": "bf16",
+                "batch_size": 8, "prompt_len": 32, "new_tokens": 128,
+                "decode_tokens_per_sec": 5000.0, "per_token_ms": 1.6}}),
+        ])
+        # batch mismatch (tok/s scales with batch) and precision mismatch
+        assert bench._emit_stale(
+            self._args(mode="decode", precision="bf16",
+                       batch_size=16)) is None
+        assert bench._emit_stale(
+            self._args(mode="decode", precision="fp32")) is None
+        assert bench._emit_stale(
+            self._args(mode="decode", precision="bf16")) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["value"] == 5000.0
+
     def test_real_log_yields_nonzero_mnist_value(self, capsys, monkeypatch):
         """The actual repo MEASURE_LOG must satisfy the driver's default
         invocation (plain ``python bench.py``) — this is the guarantee
@@ -235,6 +278,23 @@ class TestMeasureAllreduce:
         assert r["chain"] == 2
         assert r["num_devices"] == 8          # virtual CPU mesh
         assert r["algbw_gbps"] > 0
+
+    def test_main_live_path_reports_via_shared_emitter(self, monkeypatch,
+                                                       capsys):
+        """The LIVE path flows through the same _report emitter as the
+        stale fallback: one metric line, no [stale] marker, rc 0."""
+        import json
+
+        monkeypatch.setattr(bench, "_backend_reachable",
+                            lambda *a, **k: True)
+        rc = bench.main(["--mode", "allreduce", "--payload-mb", "0.05",
+                         "--steps", "2"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert out["metric"] == "gradient allreduce step time"
+        assert "[stale" not in out["metric"]
+        assert out["value"] > 0
+        assert out["detail"]["chain"] == 32
 
 
 class TestMeasureDecode:

@@ -15,5 +15,8 @@ def test_dryrun_multichip_all_strategies(capsys):
     out = capsys.readouterr().out
     for marker in ("BERT DPxTPxSP ok", "Ulysses SP ok",
                    "data-parallel psum ok", "MoE DPxEP ok",
-                   "FSDP/ZeRO ok", "pipeline PP ok"):
+                   "FSDP/ZeRO ok", "pipeline PP ok", "pipeline 1F1B ok",
+                   "pipeline PPxTP ok", "TP decode ok",
+                   "enc-dec (cross-attention) ok",
+                   "ViT data-parallel ok", "MoE-under-PP ok"):
         assert marker in out, f"strategy line missing: {marker}"
