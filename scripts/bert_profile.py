@@ -33,7 +33,10 @@ B, S, K = 64, 128, 8
 
 def build_bert(mesh):
     dropout = float(os.environ.get("PROF_DROPOUT", "0.1"))
-    use_flash = os.environ.get("PROF_FLASH", "1") == "1"
+    # default OFF: the shipping flagship is XLA dense attention (round-3
+    # measurements, flash_min_seq=4096) — profile the step we are pushing,
+    # not the retired kernel variant; PROF_FLASH=1 opts into the contrast
+    use_flash = os.environ.get("PROF_FLASH", "0") == "1"
     # flash_min_seq=0 keeps PROF_FLASH meaningful at S=128 (the default
     # threshold would force XLA attention regardless — see bert_diagnose)
     cfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16, dropout=dropout,

@@ -90,30 +90,94 @@ def record_moe() -> None:
 
 
 def record_vit() -> None:
-    """ViT through the IMAGE loop (reference semantics: momentum SGD,
-    staircase LR) on synthetic CIFAR-10: sharded test error, the
-    reference's 50-step console cadence."""
-    from mpi_tensorflow_tpu.config import Config
+    """ViT on synthetic CIFAR-10 under warmup-linear adamw — the
+    transformer families' standard recipe (train/optimizer.py
+    transformer_tx).
+
+    Measured first and documented in the trace header: under the
+    reference's plain momentum SGD (the image loop's optimizer), the
+    post-LN transformer stays AT CHANCE (~88-91% error) for 300 steps at
+    both base_lr 0.01 and 0.05 — the well-known transformers-need-
+    adaptive-optimizers property, and the reason the token families
+    default to adamw.  The convergence evidence is therefore recorded
+    under adamw; the SGD chance-floor run is preserved as
+    docs/convergence_trace_vit_sgd_floor.txt."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
     from mpi_tensorflow_tpu.data import synthetic
     from mpi_tensorflow_tpu.models import vit as vit_lib
-    from mpi_tensorflow_tpu.train import loop
+    from mpi_tensorflow_tpu.train import optimizer as opt_lib
 
-    cfg = Config(model="vit", dataset="cifar10", num_classes=10,
-                 image_size=32, epochs=4, batch_size=8, log_every=25)
     vcfg = dc.replace(vit_lib.VIT_TINY_CIFAR, hidden=64, layers=4,
-                      heads=4, mlp=128, dropout=0.1)
+                      heads=4, mlp=128, dropout=0.0)
     model = vit_lib.VisionTransformer(vcfg)
     splits = synthetic.image_classification(2048, 512, size=32, channels=3,
                                             num_classes=10)
-    r = loop.train(cfg, model=model, splits=splits)
+    params = model.init(jax.random.key(0))
+    steps, b = 300, 64
+    tx = opt_lib.transformer_tx(1e-3, steps, schedule="warmup_linear",
+                                weight_decay=0.01, grad_clip_norm=1.0)
+    opt = tx.init(params)
+
+    @jax.jit
+    def train_step(params, opt, xb, yb, rng):
+        def lf(p):
+            # train=True so a future vcfg dropout edit actually engages
+            # (apply() gates dropout on train AND rate > 0)
+            logits = model.apply(p, xb, train=True, rng=rng)
+            return jnp.mean(optax.softmax_cross_entropy_with_integer_labels(
+                logits, yb))
+
+        loss, g = jax.value_and_grad(lf)(params)
+        upd, opt = tx.update(g, opt, params)
+        return optax.apply_updates(params, upd), opt, loss
+
+    @jax.jit
+    def predict(params, xb):
+        return jnp.argmax(model.apply(params, xb), axis=-1)
+
+    tr_x = np.asarray(splits.train_data)
+    tr_y = np.asarray(splits.train_labels)
+    n = tr_x.shape[0]
+
+    def test_error(params):
+        errs = tot = 0
+        for lo in range(0, splits.test_data.shape[0] - 63, 64):
+            pred = np.asarray(predict(
+                params, jnp.asarray(splits.test_data[lo:lo + 64])))
+            errs += int((pred != splits.test_labels[lo:lo + 64]).sum())
+            tot += 64
+        return 100.0 * errs / max(tot, 1)
+
+    history = []
+    key = jax.random.key(7)
+    for t in range(steps):
+        # walk the whole split: full batches only, clean wraparound
+        lo = (t % (n // b)) * b
+        params, opt, loss = train_step(params, opt,
+                                       jnp.asarray(tr_x[lo:lo + b]),
+                                       jnp.asarray(tr_y[lo:lo + b]),
+                                       jax.random.fold_in(key, t))
+        if (t > 0 and t % 25 == 0) or t == steps - 1:
+            err = test_error(params)
+            history.append((t, err))
+            print(f"step {t}  test error {err:.1f}%", flush=True)
     _write(
         "convergence_trace_vit.txt",
         "# ViT (patchify + the shared encoder stack; hidden=64 layers=4)\n"
-        "# on synthetic CIFAR-10 through the reference-semantics image\n"
-        "# loop (momentum SGD, staircase exponential LR decay) —\n"
-        "# global test error % at the 25-step cadence: epochs=4 b=8x8dev\n"
+        "# on synthetic CIFAR-10, warmup-linear adamw 1e-3 (the\n"
+        "# transformer families' standard recipe) — global test error %\n"
+        "# at the 25-step cadence, b=64, 300 steps.  Under the\n"
+        "# reference's plain momentum SGD the post-LN transformer stays\n"
+        "# at chance (~88-91%) at base_lr 0.01 AND 0.05 for 300 steps —\n"
+        "# the known transformers-need-adaptive-optimizers property and\n"
+        "# the reason the token families default to adamw; that run is\n"
+        "# preserved as convergence_trace_vit_sgd_floor.txt\n"
         "# (recorded by scripts/record_traces.py)",
-        _fmt_history(r.history, "test error"))
+        _fmt_history(history, "test error"))
 
 
 RECORDERS = {"encdec": record_encdec, "moe": record_moe, "vit": record_vit}

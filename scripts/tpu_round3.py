@@ -105,7 +105,7 @@ ITEMS = ["bert_diagnose", "bert_profile", "resnet_profile",
          "bert_s2048_flash_remat", "bert_s2048_remat_dots",
          "bert_s4096_flash", "bert_s4096_xla",
          "bert_s8192_flash", "bert_s8192_xla",
-         "vit_b128", "resnet50_b32",
+         "vit_b128", "resnet50_b32", "resnet50_b64",
          "resnet50_b128_remat", "resnet50_b256_remat", "moe_bert",
          "gpt_base", "encdec_t5", "decode", "decode_beam",
          "bert_s512", "bert_s2048",
@@ -192,6 +192,11 @@ def main():
         model_name="vit"))
     run_item("resnet50_b32", lambda: bench.measure(
         batch_size=32, steps=48, precision="bf16", scan_steps=8,
+        model_name="resnet50"))
+    # remat-cost probe: if b64 fits WITHOUT remat and its MFU beats the
+    # b128+remat 20.2%, the recompute (not batch) is the ResNet bound
+    run_item("resnet50_b64", lambda: bench.measure(
+        batch_size=64, steps=48, precision="bf16", scan_steps=8,
         model_name="resnet50"))
     run_item("resnet50_b128_remat", lambda: bench.measure(
         batch_size=128, steps=48, precision="bf16", scan_steps=8,

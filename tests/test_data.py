@@ -136,3 +136,53 @@ def test_host_scoped_cpu_cache(tmp_path):
     assert a == b and a.startswith(str(tmp_path)) and "cpu-" in a
     import os as _os
     assert _os.path.isdir(a)
+
+
+def write_imagenet_npy_dir(tmp_path, train_n=104, test_n=64, size=32,
+                           classes=10):
+    """Real .npy shards on disk for data/imagenet.py's user-provided
+    path — shared with the end-to-end loop test in test_loop.py."""
+    import os
+
+    np_dir = tmp_path / "imagenet_npy"
+    os.makedirs(np_dir)
+    rng = np.random.default_rng(0)
+    np.save(np_dir / "train_images.npy",
+            rng.normal(size=(train_n, size, size, 3))
+            .astype(np.float32) * 0.3)
+    np.save(np_dir / "train_labels.npy",
+            rng.integers(0, classes, size=(train_n,)).astype(np.int64))
+    np.save(np_dir / "val_images.npy",
+            rng.normal(size=(test_n, size, size, 3))
+            .astype(np.float32) * 0.3)
+    np.save(np_dir / "val_labels.npy",
+            rng.integers(0, classes, size=(test_n,)).astype(np.int64))
+    return tmp_path
+
+
+class TestImagenetRealData:
+    """The user-provided .npy path of data/imagenet.py (VERDICT r3 #7):
+    real files on disk drive the mmap load and the val-split carve.  The
+    compile-heavy end-to-end loop run lives in test_loop.py (deep tier)."""
+
+    def _write_npy_dir(self, tmp_path):
+        return write_imagenet_npy_dir(tmp_path)
+
+    def test_mmap_load_and_val_split(self, tmp_path):
+        from mpi_tensorflow_tpu.data import imagenet
+
+        data_dir = self._write_npy_dir(tmp_path)
+        s = imagenet.load_splits(str(data_dir))
+        # images come back as mmap VIEWS (no eager 104-image copy) ...
+        assert isinstance(s.train_data.base, np.memmap) or \
+            isinstance(s.train_data, np.memmap)
+        # ... and the val split is the FIRST train_n//12 rows
+        val_n = 104 // 12
+        assert s.val_data.shape[0] == val_n
+        assert s.train_data.shape[0] == 104 - val_n
+        raw = np.load(str(tmp_path / "imagenet_npy" / "train_images.npy"))
+        np.testing.assert_array_equal(np.asarray(s.val_data), raw[:val_n])
+        np.testing.assert_array_equal(np.asarray(s.train_data),
+                                      raw[val_n:])
+        assert s.test_data.shape == (64, 32, 32, 3)
+

@@ -149,3 +149,23 @@ class TestFusedLoop:
         b = evaluation.eval_in_batches_fused(
             lambda w: evk(state.params, state.model_state, w), data, 64)
         np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+
+
+class TestImagenetRealFilesLoop:
+    def test_end_to_end_loop_over_real_files(self, tmp_path):
+        """The image loop trains from mmap-backed real .npy files exactly
+        as from in-memory splits: finite errors at the trace cadence
+        (VERDICT r3 #7; file fixture shared with tests/test_data.py)."""
+        import numpy as np
+
+        from mpi_tensorflow_tpu.config import Config
+        from mpi_tensorflow_tpu.train import loop
+        from test_data import write_imagenet_npy_dir
+
+        data_dir = write_imagenet_npy_dir(tmp_path)
+        cfg = Config(model="resnet20", dataset="imagenet_synthetic",
+                     data_dir=str(data_dir), num_classes=10, image_size=32,
+                     epochs=1, batch_size=4, log_every=2)
+        r = loop.train(cfg, verbose=False)
+        assert r.history, "no trace points recorded"
+        assert np.isfinite(r.final_test_error)
