@@ -173,7 +173,10 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             (lambda x: lax.psum(x, tp_axis))
         q, k, v = bert_lib.qkv_proj(lp, h, dt,   # local head subset if TP
                                     fused=self.cfg.fused_qkv)
-        a = ring.dense_attention(q, k, v)
+        # self.causal: False for the MLM family, True for the pipelined
+        # causal LM (models/gpt.PipelinedCausalLm) — the mask is the only
+        # attention difference, exactly as on the non-pipelined path
+        a = ring.dense_attention(q, k, v, causal=self.causal)
         a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
         h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)
         m = self._plain_mlp(lp, h, reduce)

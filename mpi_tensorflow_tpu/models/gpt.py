@@ -26,7 +26,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from mpi_tensorflow_tpu.models import bert as bert_lib
+from mpi_tensorflow_tpu.models import bert_pipeline
 from mpi_tensorflow_tpu.models.bert import _layernorm
+
+
+def _shift_targets(tokens):
+    """THE next-token supervision definition, shared by the plain and
+    pipelined causal families (they are not linked by MRO): targets are
+    the inputs shifted left padded with 0, and the final position's
+    weight is 0 (unsupervised).  Returns ``(targets, weights)``."""
+    targets = jnp.concatenate(
+        [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+    w = jnp.ones(tokens.shape, jnp.float32).at[:, -1].set(0.0)
+    return targets, w
 
 
 @dataclasses.dataclass(frozen=True)
@@ -42,10 +54,8 @@ class CausalLm(bert_lib.BertMlm):
         tokens = batch["tokens"] if isinstance(batch, dict) else batch
         h, aux = self._encode_aux(params, tokens, train=train, rng=rng)
         t = self.head_hidden(params, h)
-        targets = jnp.concatenate(
-            [tokens[:, 1:], jnp.zeros_like(tokens[:, :1])], axis=1)
+        targets, w = _shift_targets(tokens)
         ce = self._ce(params, t, targets)                       # (B, S)
-        w = jnp.ones_like(ce).at[:, -1].set(0.0)                # drop last
         loss = jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
         return loss + self._aux_weight() * aux, model_state
 
@@ -333,3 +343,39 @@ class CausalLm(bert_lib.BertMlm):
         choice = jax.random.categorical(key, srt, axis=-1)  # sorted slot
         return jnp.take_along_axis(
             idx, choice[:, None], axis=-1)[:, 0].astype(jnp.int32)
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelinedCausalLm(bert_pipeline.PipelinedBertMlm):
+    """Causal LM under pipeline parallelism: the decoder-only stack
+    pipelined over the mesh's ``pipe`` axis (GPipe or 1F1B,
+    bert_pipeline.PipelinedBertMlm), every stage layer attending with the
+    autoregressive mask (``causal=True`` flows into the stage body's
+    ``dense_attention`` exactly as on the non-pipelined path).
+
+    Loss: next-token CE over every position (final position
+    unsupervised), expressed through the inherited pipelined loss by
+    passing shifted targets as labels and the position weights as the
+    mask — ``cfg.ce_positions`` must be "all" (guarded at construction:
+    the pipelined loss consults the config directly, and masked-position
+    packing is an MLM concept)."""
+    causal: bool = True
+
+    def __post_init__(self):
+        super().__post_init__()
+        if self.cfg.ce_positions != "all":
+            raise ValueError(
+                "PipelinedCausalLm computes next-token CE at every "
+                "position; construct it with ce_positions='all' "
+                f"(got {self.cfg.ce_positions!r}) rather than silently "
+                "ignoring the packing config")
+
+    def loss(self, params, model_state, batch, labels=None, *, rng=None,
+             train: bool = False):
+        """``batch``: dict with ``tokens`` (B, S) or the raw array;
+        ``labels`` is ignored — targets are the inputs shifted left."""
+        tokens = batch["tokens"] if isinstance(batch, dict) else batch
+        targets, w = _shift_targets(tokens)
+        return super().loss(params, model_state,
+                            {"tokens": tokens, "mask": w}, targets,
+                            rng=rng, train=train)

@@ -80,15 +80,25 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     elif config.model == "gpt_base":
         from mpi_tensorflow_tpu.models import gpt
 
-        model = gpt.CausalLm(bert_cfg, mesh=mesh)
+        if mesh.shape.get("pipe", 1) > 1:
+            # causal LM under PP (the plain CausalLm would silently
+            # ignore the pipe axis); the pipelined loss consults
+            # ce_positions directly, and packing is an MLM concept
+            model = gpt.PipelinedCausalLm(
+                dataclasses.replace(bert_cfg, ce_positions="all"),
+                mesh=mesh, schedule=config.pp_schedule)
+        else:
+            model = gpt.CausalLm(bert_cfg, mesh=mesh)
     elif config.model == "encdec_t5":
         from mpi_tensorflow_tpu.models import encdec
 
-        if any(v > 1 for k, v in mesh.shape.items() if k != "data"):
+        if any(v > 1 for k, v in mesh.shape.items()
+               if k not in ("data", "model")):
             raise ValueError(
-                f"the encoder-decoder family is data-parallel only this "
-                f"round (mesh {dict(mesh.shape)}); drop the non-data "
-                f"axes rather than silently ignoring them")
+                f"the encoder-decoder family supports data x model "
+                f"(Megatron TP) meshes only this round (mesh "
+                f"{dict(mesh.shape)}); drop the other axes rather than "
+                f"silently ignoring them")
         model = encdec.EncDecLm(bert_cfg)
     elif mesh.shape.get("pipe", 1) > 1:
         from mpi_tensorflow_tpu.models import bert_pipeline

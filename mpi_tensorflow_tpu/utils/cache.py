@@ -27,11 +27,25 @@ def host_scoped_cpu_cache(base: str) -> str:
         with open("/proc/cpuinfo") as f:
             text = f.read()
         # x86 lists ISA extensions under "flags", aarch64 under
-        # "Features"; if neither is present, fingerprint the whole file —
-        # a constant fallback would let foreign AOT entries stay
-        # reachable, the exact hazard this module exists to close
-        flags = next((ln for ln in text.splitlines()
-                      if ln.startswith(("flags", "Features"))), text)
+        # "Features".  The flags alone are NOT enough: LLVM's
+        # -mcpu=native tuning attributes (+prefer-no-gather/-scatter,
+        # set per CPU MODEL from CPUID family/model) vary between hosts
+        # whose visible flag sets are identical — observed round 4 as a
+        # cached AOT entry compiled with +prefer-no-gather crashing the
+        # suite ("Fatal Python error") on a same-flags host without it.
+        # So the fingerprint includes the model-identity lines too.
+        # If none are present, fingerprint the whole file — a constant
+        # fallback would let foreign AOT entries stay reachable, the
+        # exact hazard this module exists to close.
+        keys = ("flags", "Features", "model name", "model", "cpu family",
+                "stepping", "vendor_id", "CPU implementer", "CPU part",
+                "CPU variant")
+        seen = {}
+        for ln in text.splitlines():
+            k = ln.split(":", 1)[0].strip()
+            if k in keys and k not in seen:
+                seen[k] = ln.strip()
+        flags = "\n".join(seen[k] for k in keys if k in seen) or text
     except OSError:
         flags = platform.processor() or platform.machine()
     tag = hashlib.sha1(flags.encode()).hexdigest()[:12]

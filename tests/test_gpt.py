@@ -400,3 +400,91 @@ class TestShardedDecode:
         k0 = new_cache[0]["k"]
         spec = k0.sharding.spec
         assert len(spec) >= 2 and spec[1] == "model", spec
+
+
+class TestPipelinedCausalLm:
+    """GPT under PP (models/gpt.PipelinedCausalLm): causal attention
+    inside pipelined stages, next-token loss through the pipelined
+    machinery — the last family x strategy pair the CLI accepts that
+    previously ignored the pipe axis silently."""
+
+    CFG = dataclasses.replace(bert.BERT_TINY, vocab_size=256, hidden=32,
+                              layers=4, heads=4, mlp=64, max_positions=32,
+                              dropout=0.0, ce_positions="all")
+
+    @pytest.fixture(scope="class")
+    def mesh_pd(self):
+        return meshlib.make_mesh({"pipe": 2, "data": 4})
+
+    def _tokens(self, n=8, seq=16, seed=0):
+        r = np.random.default_rng(seed)
+        return jnp.asarray(r.integers(0, self.CFG.vocab_size, (n, seq)),
+                           jnp.int32)
+
+    def test_pipelined_loss_matches_plain_causal(self, mesh_pd):
+        from mpi_tensorflow_tpu.models import bert_pipeline
+        from mpi_tensorflow_tpu.parallel import sharding_rules
+
+        plain = gpt.CausalLm(self.CFG)
+        params = plain.init(jax.random.key(0))
+        piped = gpt.PipelinedCausalLm(self.CFG, mesh=mesh_pd,
+                                      num_microbatches=2)
+        pparams = dict(params)
+        pparams["layers"] = bert_pipeline.stack_layers(params["layers"], 2)
+        pparams = sharding_rules.shard_tree(pparams, piped.logical_axes(),
+                                            mesh_pd)
+        toks = self._tokens()
+        l_plain, _ = plain.loss(params, None, {"tokens": toks}, None)
+        l_pipe, _ = piped.loss(pparams, None, {"tokens": toks}, None)
+        np.testing.assert_allclose(float(l_plain), float(l_pipe),
+                                   rtol=1e-5)
+
+    def test_1f1b_matches_gpipe_and_trains(self, mesh_pd):
+        from mpi_tensorflow_tpu.parallel import sharding_rules
+
+        gp = gpt.PipelinedCausalLm(self.CFG, mesh=mesh_pd,
+                                   num_microbatches=2)
+        ob = gpt.PipelinedCausalLm(self.CFG, mesh=mesh_pd,
+                                   num_microbatches=2, schedule="1f1b")
+        params = gp.init(jax.random.key(0))
+        params = sharding_rules.shard_tree(params, gp.logical_axes(),
+                                           mesh_pd)
+        toks = self._tokens()
+        l_gp, _ = gp.loss(params, None, {"tokens": toks}, None, train=True)
+        l_ob, _ = ob.loss(params, None, {"tokens": toks}, None, train=True)
+        np.testing.assert_allclose(float(l_gp), float(l_ob), rtol=1e-5)
+        # and a full train step through gspmd executes with finite loss
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_gspmd_state(gp, tx, jax.random.key(0), mesh_pd)
+        step = gspmd.make_gspmd_train_step(gp, mesh_pd, tx)
+        b = gspmd.shard_batch({"tokens": np.asarray(self._tokens())},
+                              mesh_pd)
+        t = gspmd.shard_batch(np.asarray(self._tokens()), mesh_pd)
+        state, m = step(state, b, t, jax.random.key(1))
+        jax.block_until_ready(state)
+        assert np.isfinite(float(m["loss"]))
+
+    def test_requires_all_positions(self, mesh_pd):
+        with pytest.raises(ValueError, match="ce_positions"):
+            gpt.PipelinedCausalLm(
+                dataclasses.replace(self.CFG, ce_positions="masked"),
+                mesh=mesh_pd)
+
+    def test_stage_attention_is_causal(self, mesh_pd):
+        """Perturbing a future token must not move earlier positions'
+        per-position CE through the pipelined forward."""
+        from mpi_tensorflow_tpu.models import bert_pipeline
+        from mpi_tensorflow_tpu.parallel import sharding_rules
+
+        piped = gpt.PipelinedCausalLm(self.CFG, mesh=mesh_pd,
+                                      num_microbatches=2)
+        params = piped.init(jax.random.key(0))
+        params = sharding_rules.shard_tree(params, piped.logical_axes(),
+                                           mesh_pd)
+        toks = self._tokens()
+        h1, _ = piped._encode_aux(params, toks)
+        toks2 = toks.at[:, -1].set((toks[:, -1] + 1) % self.CFG.vocab_size)
+        h2, _ = piped._encode_aux(params, toks2)
+        np.testing.assert_array_equal(np.asarray(h1[:, :-1]),
+                                      np.asarray(h2[:, :-1]))
+        assert not np.allclose(np.asarray(h1[:, -1]), np.asarray(h2[:, -1]))

@@ -18,5 +18,6 @@ def test_dryrun_multichip_all_strategies(capsys):
                    "FSDP/ZeRO ok", "pipeline PP ok", "pipeline 1F1B ok",
                    "pipeline PPxTP ok", "TP decode ok",
                    "enc-dec (cross-attention) ok",
-                   "ViT data-parallel ok", "MoE-under-PP ok"):
+                   "ViT data-parallel ok", "MoE-under-PP ok",
+                   "GPT-under-PP ok", "enc-dec TP ok"):
         assert marker in out, f"strategy line missing: {marker}"
