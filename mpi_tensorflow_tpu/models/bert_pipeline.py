@@ -38,8 +38,12 @@ Memory schedules, from cheapest to most capable:
   tests/test_moe_pipeline.py::TestOneFOneB.
 ``cfg.remat`` additionally recomputes within-stage activations in the
 backward.  TP inside a stage works with both schedules (the 1F1B path
-runs a vocab-parallel CE in-schedule); SP inside a stage remains future
-work.
+runs a vocab-parallel CE in-schedule); SP inside a stage works with the
+GPipe schedule — activations sequence-sharded over the ``seq`` mesh
+axis, stage attention as blockwise ring attention (ppermute neighbor
+hops), dropout decorrelated per (data, seq) shard — composing to
+``pipe x model x seq x data``.  1F1B + SP is guarded at construction
+(the in-schedule head math is not sequence-parallel).
 
 No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
 """
@@ -132,6 +136,16 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             raise ValueError(
                 f"pipelined BERT supports pos_kind='learned' only "
                 f"(got {self.cfg.pos_kind!r})")
+        if self.schedule == "1f1b" and self.mesh is not None \
+                and self.mesh.shape.get("seq", 1) > 1:
+            # the 1F1B path computes the head/CE INSIDE the schedule on
+            # per-shard activations; under sequence sharding that math
+            # would need a seq gather (or a sequence-parallel CE) that
+            # is not implemented — GPipe composes with SP, use that
+            raise ValueError(
+                "schedule='1f1b' does not compose with a 'seq' mesh axis "
+                "this round (in-schedule head math is not "
+                "sequence-parallel); use the gpipe schedule with SP")
 
     def init(self, rng):
         params = super().init(rng)
@@ -150,7 +164,7 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                                      for kk, vv in v.items()}
         return axes
 
-    def _plain_layer(self, lp, h, drop=None, tp_axis=None):
+    def _plain_layer(self, lp, h, drop=None, tp_axis=None, seq_axis=None):
         """One encoder layer with no mesh constraints — runs inside the
         pipe ``shard_map`` where GSPMD annotations are unavailable.  Same
         math as BertMlm's layer.  ``drop``: ``None`` (eval / dropout off) or
@@ -161,7 +175,14 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         stage's heads/MLP-hidden arrive sharded over that mesh axis
         (column-parallel in), and the two row-parallel output projections
         are manually ``psum``'d; biases of the row-parallel outputs are
-        added once, after the reduction."""
+        added once, after the reduction.
+
+        ``seq_axis``: sequence parallelism INSIDE the stage — ``h``
+        arrives sequence-sharded over that mesh axis and attention runs
+        as blockwise ring attention (``parallel/ring.ring_attention``,
+        ppermute neighbor hops); everything else in the layer is
+        position-local and needs no change.  Composes with ``tp_axis``
+        (attention is independent per local head subset)."""
         dt = self.cfg.dtype
 
         def dropout(x, site):
@@ -176,7 +197,10 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         # self.causal: False for the MLM family, True for the pipelined
         # causal LM (models/gpt.PipelinedCausalLm) — the mask is the only
         # attention difference, exactly as on the non-pipelined path
-        a = ring.dense_attention(q, k, v, causal=self.causal)
+        if seq_axis is not None:
+            a = ring.ring_attention(q, k, v, seq_axis, causal=self.causal)
+        else:
+            a = ring.dense_attention(q, k, v, causal=self.causal)
         a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
         h = _layernorm(h + dropout(a, 0), lp["ln1"]).astype(dt)
         m = self._plain_mlp(lp, h, reduce)
@@ -196,7 +220,7 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         return True
 
     def _stage(self, stage_params, x, rng=None, mb_idx=None,
-               stage_idx=None, tp_axis=None):
+               stage_idx=None, tp_axis=None, seq_axis=None):
         """Run this stage's L/P layers sequentially (scan over the layer
         dim of the stacked params).  When ``rng`` is set, dropout keys are
         folded on (microbatch, global layer, site) so every microbatch at
@@ -212,8 +236,8 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 gl = stage_idx * Lp + li      # global layer index
                 kb = jax.random.fold_in(jax.random.fold_in(rng, mb_idx), gl)
                 drop = lambda site: jax.random.fold_in(kb, site)  # noqa: E731
-            return self._plain_layer(lp, h, drop=drop,
-                                     tp_axis=tp_axis), None
+            return self._plain_layer(lp, h, drop=drop, tp_axis=tp_axis,
+                                     seq_axis=seq_axis), None
 
         if self.cfg.remat:
             # recompute stage activations in the backward pipeline: the
@@ -257,31 +281,40 @@ class PipelinedBertMlm(bert_lib.BertMlm):
 
         M = self.num_microbatches
         dp = self.mesh.shape.get("data", 1)
+        sp = self.mesh.shape.get("seq", 1)
         if (B // dp) % M:
             raise ValueError(
                 f"per-data-shard batch {B // dp} not divisible by "
                 f"{M} microbatches")
-        h_spec = P("data" if dp > 1 else None)
+        if S % sp:
+            raise ValueError(
+                f"sequence length {S} not divisible by the seq axis {sp}")
+        h_spec = P("data" if dp > 1 else None, "seq" if sp > 1 else None)
         tp_axis = "model" if self.mesh.shape.get("model", 1) > 1 else None
+        seq_axis = "seq" if sp > 1 else None
 
         def inner(stacked_local, hl, key):
             stage_params = jax.tree.map(lambda x: x[0], stacked_local)
             mb = hl.reshape((M, hl.shape[0] // M) + hl.shape[1:])
             if dropping:
-                # decorrelate the data shards' masks too (each data shard
-                # pipelines a different slice of the global batch); model
-                # shards keep the SAME key — their outputs are replicated
-                key = jax.random.fold_in(
-                    key, lax.axis_index("data") if dp > 1 else 0)
+                # decorrelate the data AND seq shards' masks (each holds
+                # a different slice of the global (B, S) activation
+                # grid); model shards keep the SAME key — their outputs
+                # are replicated.  sp==1 reduces to the data-only fold.
+                shard_id = (lax.axis_index("data") if dp > 1 else 0) * sp \
+                    + (lax.axis_index("seq") if sp > 1 else 0)
+                key = jax.random.fold_in(key, shard_id)
                 sidx = lax.axis_index("pipe")
                 out = pipeline_lib.pipeline(
                     lambda p, x, mi: self._stage(p, x, rng=key, mb_idx=mi,
                                                  stage_idx=sidx,
-                                                 tp_axis=tp_axis),
+                                                 tp_axis=tp_axis,
+                                                 seq_axis=seq_axis),
                     stage_params, mb, "pipe", with_mb_index=True)
             else:
                 out = pipeline_lib.pipeline(
-                    lambda p, x: self._stage(p, x, tp_axis=tp_axis),
+                    lambda p, x: self._stage(p, x, tp_axis=tp_axis,
+                                             seq_axis=seq_axis),
                     stage_params, mb, "pipe")
             return out.reshape(hl.shape)
 

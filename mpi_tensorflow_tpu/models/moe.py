@@ -230,7 +230,10 @@ class PipelinedMoeBertMlm(bert_pipeline.PipelinedBertMlm, MoeBertMlm):
                 "MoeConfig(aux_loss_weight=0.0) explicitly rather than "
                 "silently dropping the term")
         if self.mesh is not None:
-            for axis in ("expert", "model"):
+            # seq: the routed dispatch computes capacity/positions over
+            # its LOCAL tokens — under sequence sharding that silently
+            # becomes per-shard routing, a different algorithm
+            for axis in ("expert", "model", "seq"):
                 if self.mesh.shape.get(axis, 1) > 1:
                     raise ValueError(
                         f"pipelined MoE supports pipe x data meshes only "

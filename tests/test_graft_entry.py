@@ -19,5 +19,6 @@ def test_dryrun_multichip_all_strategies(capsys):
                    "pipeline PPxTP ok", "TP decode ok",
                    "enc-dec (cross-attention) ok",
                    "ViT data-parallel ok", "MoE-under-PP ok",
+                   "pipeline PPxSP ok",
                    "GPT-under-PP ok", "enc-dec TP ok"):
         assert marker in out, f"strategy line missing: {marker}"
