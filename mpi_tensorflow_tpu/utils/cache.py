@@ -1,13 +1,27 @@
-"""Host-scoped persistent-compilation-cache paths.
+"""Host-scoped persistent-compilation-cache paths + a round-trip safety
+canary.
 
 XLA:CPU stores AOT-compiled executables keyed WITHOUT the full host
 machine-feature set; loading an entry compiled on a different CPU type
 warns "This could lead to execution errors such as SIGILL" — and does
-exactly that, intermittently, when a cached executable using unsupported
-instructions runs (observed twice as a mid-suite "Fatal Python error"
-on the round-3 box, whose cache had accumulated entries from earlier
-rounds' hosts).  Scoping the CPU cache by a fingerprint of the host's
-instruction set makes a foreign entry unreachable instead of fatal.
+exactly that, intermittently (observed as a mid-suite "Fatal Python
+error" in rounds 3 and 4).  Two distinct hazards, both closed here:
+
+1. FOREIGN entries (different box, same cache dir): closed by scoping
+   the CPU cache under a fingerprint of the host's ISA *and model
+   identity* (LLVM's -mcpu=native tuning differs between models whose
+   /proc/cpuinfo flags are identical).
+2. SAME-HOST reload (round-4 root cause): on some boxes LLVM's native
+   tuning adds attributes (+prefer-no-gather/-scatter) that the AOT
+   loader cannot verify against its host-feature probe, so the box
+   cannot round-trip ITS OWN cache — every load warns "Machine type
+   used for XLA:CPU compilation doesn't match", and a gather-heavy
+   executable (the gspmd train step) aborted deterministically on
+   reload.  ``cpu_cache_roundtrip_safe`` detects this once per box
+   with a compile-in-one-process / reload-in-another canary and
+   persists the verdict; callers must leave the CPU cache OFF when it
+   returns False.
+
 TPU entries are unaffected (device executables, loaded by the runtime,
 not host-executed) and keep using the base directory.
 """
@@ -17,6 +31,7 @@ from __future__ import annotations
 import hashlib
 import os
 import platform
+import sys
 
 
 def host_scoped_cpu_cache(base: str) -> str:
@@ -54,3 +69,111 @@ def host_scoped_cpu_cache(base: str) -> str:
     path = os.path.join(base, f"cpu-{tag}")
     os.makedirs(path, exist_ok=True)
     return path
+
+
+_CANARY = r"""
+import os, sys
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_compilation_cache_dir", sys.argv[1])
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+@jax.jit
+def canary(x, idx):
+    # a gather: the op class whose codegen the unverifiable
+    # prefer-no-gather tuning attribute changes
+    return jnp.take(x, idx, axis=0).sum() * 2.0
+
+out = canary(jnp.arange(64.0).reshape(8, 8), jnp.array([1, 3, 5]))
+print("CANARY_OK", float(out))
+"""
+
+
+_ROUNDTRIP_MEMO: dict = {}   # (isa tag, jaxlib ver) -> bool, per process
+
+
+def _jaxlib_version() -> str:
+    try:
+        from importlib.metadata import version
+
+        return version("jaxlib")
+    except Exception:
+        return "unknown"
+
+
+def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
+    """True when this box can reload its OWN XLA:CPU AOT cache entries.
+
+    Compiles a small gather-containing jit in one subprocess (writing the
+    entry into a throwaway dir), reloads it in a second, and checks the
+    second's stderr for the AOT loader's machine-type mismatch warning —
+    the signature of the same-host tuning-attribute hazard that aborted
+    the round-4 suite.  The verdict persists next to the scoped dir,
+    keyed by the jaxlib version (a loader upgrade re-probes), and is
+    memoized per (ISA tag, version) in-process so multiple cache bases
+    in one session pay ONE probe.  A canary INFRASTRUCTURE failure
+    (compile subprocess fails/times out) reports False for this session
+    but is NOT persisted — the next session retries; only a completed
+    probe writes a verdict."""
+    tag = os.path.basename(os.path.normpath(scoped_dir))
+    ver = _jaxlib_version()
+    memo_key = (tag, ver)
+    if memo_key in _ROUNDTRIP_MEMO:
+        return _ROUNDTRIP_MEMO[memo_key]
+    verdict_path = f"{os.path.normpath(scoped_dir)}.{ver}.roundtrip"
+    if os.path.exists(verdict_path):
+        with open(verdict_path) as f:
+            safe = f.read().strip() == "safe"
+        _ROUNDTRIP_MEMO[memo_key] = safe
+        return safe
+
+    import subprocess
+    import tempfile
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the tunnel here
+    env["JAX_PLATFORMS"] = "cpu"
+    cache = tempfile.mkdtemp(prefix="canary-", dir=os.path.dirname(
+        os.path.normpath(scoped_dir)) or ".")
+    verdict = None                           # None = probe did not complete
+    try:
+        r1 = subprocess.run([sys.executable, "-c", _CANARY, cache],
+                            capture_output=True, text=True, env=env,
+                            timeout=timeout)
+        if r1.returncode == 0 and "CANARY_OK" in r1.stdout:
+            r2 = subprocess.run([sys.executable, "-c", _CANARY, cache],
+                                capture_output=True, text=True, env=env,
+                                timeout=timeout)
+            if r2.returncode == 0 and "CANARY_OK" in r2.stdout \
+                    and "doesn't match the machine type" not in r2.stderr \
+                    and "supported on the host machine" not in r2.stderr:
+                verdict = "safe"
+            else:
+                # the reload leg itself warned or crashed: THE hazard
+                verdict = "unsafe"
+        # r1 failing is an infrastructure problem, not a reload verdict
+    except Exception:
+        pass                                 # fail-safe: cache off
+    finally:
+        import shutil
+
+        shutil.rmtree(cache, ignore_errors=True)
+    if verdict is not None:
+        with open(verdict_path, "w") as f:
+            f.write(verdict)
+    safe = verdict == "safe"
+    _ROUNDTRIP_MEMO[memo_key] = safe
+    return safe
+
+
+def gated_cpu_cache(base: str):
+    """THE one entry point for pointing an XLA:CPU run at a persistent
+    compilation cache: host-scoped path when this box round-trips its
+    own entries, ``None`` (= leave the cache off) when it does not.
+    Every place that sets ``jax_compilation_cache_dir`` or
+    ``JAX_COMPILATION_CACHE_DIR`` for a forced-CPU run must go through
+    here — a direct ``host_scoped_cpu_cache`` call reopens the
+    same-host reload abort this module exists to close."""
+    scoped = host_scoped_cpu_cache(base)
+    return scoped if cpu_cache_roundtrip_safe(scoped) else None

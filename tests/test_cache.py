@@ -1,0 +1,117 @@
+"""utils/cache.py: host-scoped XLA:CPU cache paths + the round-trip
+safety canary (both "Fatal Python error" hazards — foreign AOT entries
+and same-host reload — are closed here)."""
+
+import os
+
+import pytest
+
+from mpi_tensorflow_tpu.utils import cache
+
+pytestmark = pytest.mark.quick
+
+
+def test_host_scoped_cpu_cache(tmp_path):
+    """Foreign-machine XLA:CPU AOT entries can SIGILL; the cache path
+    must be fingerprinted (ISA + CPU model identity), stable, and
+    auto-created."""
+    a = cache.host_scoped_cpu_cache(str(tmp_path))
+    b = cache.host_scoped_cpu_cache(str(tmp_path))
+    assert a == b and a.startswith(str(tmp_path)) and "cpu-" in a
+    assert os.path.isdir(a)
+
+
+class TestRoundtripVerdict:
+    def _scoped(self, tmp_path):
+        scoped = tmp_path / "cpu-deadbeef0000"
+        scoped.mkdir()
+        return scoped
+
+    def _verdict_file(self, tmp_path):
+        ver = cache._jaxlib_version()
+        return tmp_path / f"cpu-deadbeef0000.{ver}.roundtrip"
+
+    def test_persisted_verdict_is_authoritative(self, tmp_path,
+                                                monkeypatch):
+        """An existing verdict short-circuits — the expensive
+        two-subprocess probe must not rerun."""
+        monkeypatch.setattr(cache, "_ROUNDTRIP_MEMO", {})
+        scoped = self._scoped(tmp_path)
+        self._verdict_file(tmp_path).write_text("safe")
+        assert cache.cpu_cache_roundtrip_safe(str(scoped)) is True
+        monkeypatch.setattr(cache, "_ROUNDTRIP_MEMO", {})
+        self._verdict_file(tmp_path).write_text("unsafe")
+        assert cache.cpu_cache_roundtrip_safe(str(scoped)) is False
+
+    def test_verdict_is_jaxlib_version_keyed(self, tmp_path, monkeypatch):
+        """A verdict recorded under another jaxlib version must not apply
+        — a loader upgrade can change reload behavior, so the box
+        re-probes."""
+        monkeypatch.setattr(cache, "_ROUNDTRIP_MEMO", {})
+        scoped = self._scoped(tmp_path)
+        (tmp_path / "cpu-deadbeef0000.0.0.0.roundtrip").write_text("safe")
+        probes = []
+
+        def fake_run(*a, **k):
+            probes.append(1)
+            raise RuntimeError("probe infrastructure down")
+
+        import subprocess
+
+        monkeypatch.setattr(subprocess, "run", fake_run)
+        # stale-version verdict ignored -> probe attempted -> infra
+        # failure -> conservative False
+        assert cache.cpu_cache_roundtrip_safe(str(scoped)) is False
+        assert probes, "stale-version verdict was wrongly honored"
+
+    def test_infrastructure_failure_not_persisted(self, tmp_path,
+                                                  monkeypatch):
+        """A probe that never completes (timeout/crash of the COMPILE
+        leg) must not write a permanent verdict — the next session
+        retries instead of running uncached forever."""
+        monkeypatch.setattr(cache, "_ROUNDTRIP_MEMO", {})
+        scoped = self._scoped(tmp_path)
+
+        import subprocess
+
+        monkeypatch.setattr(
+            subprocess, "run",
+            lambda *a, **k: (_ for _ in ()).throw(
+                subprocess.TimeoutExpired("x", 1)))
+        assert cache.cpu_cache_roundtrip_safe(str(scoped)) is False
+        assert not self._verdict_file(tmp_path).exists()
+
+    def test_memo_shares_one_probe_across_cache_bases(self, tmp_path,
+                                                      monkeypatch):
+        """Two cache BASES with the same ISA tag in one session must pay
+        one probe (the verdict is a property of the box, not the
+        path)."""
+        monkeypatch.setattr(cache, "_ROUNDTRIP_MEMO", {})
+        a = tmp_path / "base_a" / "cpu-deadbeef0000"
+        b = tmp_path / "base_b" / "cpu-deadbeef0000"
+        a.mkdir(parents=True)
+        b.mkdir(parents=True)
+        (tmp_path / "base_a" /
+         f"cpu-deadbeef0000.{cache._jaxlib_version()}.roundtrip"
+         ).write_text("safe")
+        assert cache.cpu_cache_roundtrip_safe(str(a)) is True
+        probes = []
+
+        import subprocess
+
+        monkeypatch.setattr(subprocess, "run",
+                            lambda *a, **k: probes.append(1))
+        # second base, same tag: memo hit, no probe, no verdict file read
+        assert cache.cpu_cache_roundtrip_safe(str(b)) is True
+        assert not probes
+
+    def test_gated_cpu_cache_returns_none_when_unsafe(self, tmp_path,
+                                                      monkeypatch):
+        monkeypatch.setattr(cache, "_ROUNDTRIP_MEMO", {})
+        monkeypatch.setattr(cache, "cpu_cache_roundtrip_safe",
+                            lambda *a, **k: False)
+        assert cache.gated_cpu_cache(str(tmp_path)) is None
+        monkeypatch.setattr(cache, "cpu_cache_roundtrip_safe",
+                            lambda *a, **k: True)
+        out = cache.gated_cpu_cache(str(tmp_path))
+        assert out is not None and "cpu-" in out

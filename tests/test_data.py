@@ -125,19 +125,6 @@ class TestMnist:
         np.testing.assert_array_equal(a, b)
 
 
-@pytest.mark.quick
-def test_host_scoped_cpu_cache(tmp_path):
-    """Foreign-machine XLA:CPU AOT entries can SIGILL; the cache path
-    must be ISA-fingerprinted, stable, and auto-created."""
-    from mpi_tensorflow_tpu.utils.cache import host_scoped_cpu_cache
-
-    a = host_scoped_cpu_cache(str(tmp_path))
-    b = host_scoped_cpu_cache(str(tmp_path))
-    assert a == b and a.startswith(str(tmp_path)) and "cpu-" in a
-    import os as _os
-    assert _os.path.isdir(a)
-
-
 def write_imagenet_npy_dir(tmp_path, train_n=104, test_n=64, size=32,
                            classes=10):
     """Real .npy shards on disk for data/imagenet.py's user-provided
@@ -185,4 +172,5 @@ class TestImagenetRealData:
         np.testing.assert_array_equal(np.asarray(s.train_data),
                                       raw[val_n:])
         assert s.test_data.shape == (64, 32, 32, 3)
+
 

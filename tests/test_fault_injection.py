@@ -66,11 +66,16 @@ def _cli_env():
     env["JAX_PLATFORMS"] = "cpu"
     env["JAX_NUM_CPU_DEVICES"] = "8"
     env["PYTHONUNBUFFERED"] = "1"
-    from mpi_tensorflow_tpu.utils.cache import host_scoped_cpu_cache
+    from mpi_tensorflow_tpu.utils.cache import gated_cpu_cache
 
-    # host-scoped: a foreign-machine AOT entry can SIGILL (utils/cache.py)
-    env["JAX_COMPILATION_CACHE_DIR"] = host_scoped_cpu_cache(
-        os.path.join(REPO, ".jax_cache"))
+    # host-scoped AND round-trip-gated: a foreign-machine AOT entry can
+    # SIGILL, and some boxes cannot reload their OWN entries — the CLI
+    # children must never open that hazard (utils/cache.py)
+    scoped = gated_cpu_cache(os.path.join(REPO, ".jax_cache"))
+    if scoped is not None:
+        env["JAX_COMPILATION_CACHE_DIR"] = scoped
+    else:
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
     return env
 
 
