@@ -38,12 +38,15 @@ Memory schedules, from cheapest to most capable:
   tests/test_moe_pipeline.py::TestOneFOneB.
 ``cfg.remat`` additionally recomputes within-stage activations in the
 backward.  TP inside a stage works with both schedules (the 1F1B path
-runs a vocab-parallel CE in-schedule); SP inside a stage works with the
-GPipe schedule — activations sequence-sharded over the ``seq`` mesh
+runs a vocab-parallel CE in-schedule); SP inside a stage works with
+BOTH schedules too — activations sequence-sharded over the ``seq`` mesh
 axis, stage attention as blockwise ring attention (ppermute neighbor
 hops), dropout decorrelated per (data, seq) shard — composing to
-``pipe x model x seq x data``.  1F1B + SP is guarded at construction
-(the in-schedule head math is not sequence-parallel).
+``pipe x model x seq x data``.  Under 1F1B the in-schedule CE must be
+position-local (``ce_positions="all"``; guarded — masked-position
+packing gathers across the sequence), and the schedule runs its stage
+bodies unconditionally every tick (collectives inside a slot-gated
+``lax.cond`` are unsound — see ``pipeline.pipeline_1f1b``).
 
 No counterpart in the reference (SURVEY.md §2 checklist: PP absent).
 """
@@ -137,15 +140,19 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 f"pipelined BERT supports pos_kind='learned' only "
                 f"(got {self.cfg.pos_kind!r})")
         if self.schedule == "1f1b" and self.mesh is not None \
-                and self.mesh.shape.get("seq", 1) > 1:
-            # the 1F1B path computes the head/CE INSIDE the schedule on
-            # per-shard activations; under sequence sharding that math
-            # would need a seq gather (or a sequence-parallel CE) that
-            # is not implemented — GPipe composes with SP, use that
+                and self.mesh.shape.get("seq", 1) > 1 \
+                and self.cfg.ce_positions != "all":
+            # the 1F1B path computes the head/CE INSIDE the schedule:
+            # with ce_positions="all" that math is position-local (the
+            # tied decoder + CE act per position) and composes with
+            # sequence sharding via local sums + a seq psum — but the
+            # "masked" packing gathers rows ACROSS the sequence and is
+            # not sequence-parallel; fail rather than silently unpack
             raise ValueError(
-                "schedule='1f1b' does not compose with a 'seq' mesh axis "
-                "this round (in-schedule head math is not "
-                "sequence-parallel); use the gpipe schedule with SP")
+                "schedule='1f1b' under a 'seq' mesh axis needs "
+                "ce_positions='all' (masked-position packing gathers "
+                "across the sequence and is not sequence-parallel); "
+                "use ce_positions='all' or the gpipe schedule")
 
     def init(self, rng):
         params = super().init(rng)
@@ -416,19 +423,26 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         dropping = self._dropping(train, rng)
         M = self.num_microbatches
         dp = self.mesh.shape.get("data", 1)
+        sp = self.mesh.shape.get("seq", 1)
         if (B // dp) % M:
             raise ValueError(
                 f"per-data-shard batch {B // dp} not divisible by "
                 f"{M} microbatches")
+        if S % sp:
+            raise ValueError(
+                f"sequence length {S} not divisible by the seq axis {sp}")
         h = self._embed(params, tokens, dropping, rng)
         # global normalizer, fixed before the schedule (data-only, no
         # grad): per-microbatch SUMS scaled by it add up to exactly the
-        # GPipe path's globally normalized mean
+        # GPipe path's globally normalized mean — and, under sequence
+        # sharding, per-(data, seq)-shard partial sums scaled by it add
+        # up the same way (the "all" CE is position-local)
         inv = 1.0 / jnp.maximum(jnp.sum(mask.astype(jnp.float32)), 1.0)
         head_params = {"mlm": params["mlm"], "tok_emb": params["tok_emb"]}
         key = rng if dropping else jax.random.key(0)
-        h_spec = P("data" if dp > 1 else None)
+        h_spec = P("data" if dp > 1 else None, "seq" if sp > 1 else None)
         tp_axis = "model" if self.mesh.shape.get("model", 1) > 1 else None
+        seq_axis = "seq" if sp > 1 else None
         # the in-schedule head/CE math runs INSIDE shard_map, where GSPMD
         # sharding constraints are illegal — a mesh-free view of this model
         # computes the same math without annotations
@@ -453,28 +467,34 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 else lax.psum(g, tp_axis), grads, specs)
 
         def inner(stacked_local, hp, hl, labels_l, mask_l, inv, key):
-            sp = jax.tree.map(lambda x: x[0], stacked_local)
+            sp_params = jax.tree.map(lambda x: x[0], stacked_local)
             mbsz = hl.shape[0] // M
             mb = hl.reshape((M, mbsz) + hl.shape[1:])
             lab = labels_l.reshape((M, mbsz) + labels_l.shape[1:])
             msk = mask_l.reshape((M, mbsz) + mask_l.shape[1:])
             if dropping:
-                key = jax.random.fold_in(
-                    key, lax.axis_index("data") if dp > 1 else 0)
+                # same (data, seq) shard fold as the GPipe path — the
+                # cross-schedule mask-identity pin depends on it
+                shard_id = (lax.axis_index("data") if dp > 1 else 0) \
+                    * sp + (lax.axis_index("seq") if sp > 1 else 0)
+                key = jax.random.fold_in(key, shard_id)
             sidx = lax.axis_index("pipe")
 
             def stage_fn(p, x, mi):
                 return self._stage(p, x, rng=key if dropping else None,
                                    mb_idx=mi, stage_idx=sidx,
-                                   tp_axis=tp_axis)
+                                   tp_axis=tp_axis, seq_axis=seq_axis)
 
             def last_fn(hp, y, aux):
+                # ce_positions="all" under seq sharding: the tied
+                # decoder + CE act per position, so the local slice's
+                # sum * inv is this shard's partial of the global mean
                 labels_i, mask_i = aux
                 return plain._mb_loss(hp, y, labels_i, mask_i, inv,
                                       tp_axis=tp_axis)
 
             loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b(
-                stage_fn, last_fn, sp, hp, mb, (lab, msk), "pipe")
+                stage_fn, last_fn, sp_params, hp, mb, (lab, msk), "pipe")
             gl = _reduce_partials(gl, hp_specs)
             gs = _reduce_partials(gs, sp_specs)
             if tp_axis is not None:
@@ -487,12 +507,17 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 tp = self.mesh.shape["model"]
                 gs, gl, dmb = jax.tree.map(lambda x: x / tp,
                                            (gs, gl, dmb))
-            # sum loss/replicated-param grads over the data shards too
-            # (each shard saw a different batch slice of the global mean)
-            if dp > 1:
-                loss = lax.psum(loss, "data")
-                gl = jax.tree.map(lambda x: lax.psum(x, "data"), gl)
-                gs = jax.tree.map(lambda x: lax.psum(x, "data"), gs)
+            # sum loss/replicated-param grads over the data shards (each
+            # saw a different batch slice of the global mean) AND the seq
+            # shards (each saw a different position slice; params are
+            # seq-replicated, so their cotangents are partials — dmb is
+            # seq-SHARDED and already local-true)
+            red = tuple(a for a, n in (("data", dp), ("seq", sp))
+                        if n > 1)
+            if red:
+                loss = lax.psum(loss, red)
+                gl = jax.tree.map(lambda x: lax.psum(x, red), gl)
+                gs = jax.tree.map(lambda x: lax.psum(x, red), gs)
             # restore the stacked leading stage axis for the out_spec
             gs = jax.tree.map(lambda x: x[None], gs)
             return loss, gs, gl, dmb.reshape(hl.shape)

@@ -170,66 +170,78 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
     x_shape = microbatches.shape[1:]
     f32 = jnp.float32
 
-    def fwd_branch(carry_in):
-        fwd_msg, stash, i_f = carry_in
-        x_in = jnp.where(s_idx == 0,
-                         microbatches[i_f].astype(fwd_msg.dtype), fwd_msg)
-        y = stage_fn(stage_params, x_in, i_f)
-        stash = lax.dynamic_update_index_in_dim(
-            stash, x_in, i_f % n, 0)
-        return y, stash
-
     def tick_fn(carry, t):
         fwd_msg, bwd_msg, stash, gs, gl, loss, dx_out = carry
-        # forward: stage s runs microbatch (t-s)/2 when parity and range fit
+        # forward: stage s OWNS microbatch (t-s)/2 when parity/range fit.
+        # The stage body runs UNCONDITIONALLY every tick and its result
+        # is masked by f_on: the stage may contain collectives (ring
+        # attention's ppermute over 'seq', TP psums over 'model') whose
+        # groups span devices with the SAME pipe rank on OTHER mesh
+        # axes — a lax.cond on the pipe-dependent slot predicate would
+        # put those collectives under control flow and is UNSOUND (the
+        # minimal repro crashes XLA:CPU's thunk executor; in the full
+        # model it silently corrupted the seq-sharded forward).  GPipe's
+        # pipeline() already runs stages unconditionally; this schedule
+        # now matches, paying bubble-tick compute for collective
+        # uniformity while keeping the O(P) stash that is its point.
         f_num = t - s_idx
         i_f = jnp.clip(f_num // 2, 0, m - 1)
         f_on = (f_num >= 0) & (f_num % 2 == 0) & (f_num // 2 < m)
-        y, stash = lax.cond(
+        x_in = jnp.where(s_idx == 0,
+                         microbatches[i_f].astype(fwd_msg.dtype), fwd_msg)
+        y_all = stage_fn(stage_params, x_in, i_f)
+        y = jnp.where(f_on, y_all, jnp.zeros(x_shape, y_all.dtype))
+        # carry updates hold NO collectives — they may stay slot-gated
+        # (only the stage body must run unconditionally)
+        stash = lax.cond(
             f_on,
-            lambda c: fwd_branch(c),
-            lambda c: (jnp.zeros(x_shape, fwd_msg.dtype), c[1]),
-            (fwd_msg, stash, i_f))
+            lambda s: lax.dynamic_update_index_in_dim(s, x_in, i_f % n, 0),
+            lambda s: s, stash)
 
-        # backward: stage s runs microbatch (t-(2n-1-s))/2
+        # backward: stage s owns microbatch (t-(2n-1-s))/2.  Same rule:
+        # the stage replay (and its vjp — reverse ppermute hops) runs
+        # unconditionally; only the ACCUMULATIONS are masked by b_on.
         b_num = t - (2 * n - 1 - s_idx)
         i_b = jnp.clip(b_num // 2, 0, m - 1)
         b_on = (b_num >= 0) & (b_num % 2 == 0) & (b_num // 2 < m)
+        x = stash[i_b % n]
+        yb, vjp_fn = jax.vjp(
+            lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
 
-        def bwd_branch(c):
-            bwd_msg, stash, gs, gl, loss, dx_out, i_b = c
-            x = stash[i_b % n]
-            yb, vjp_fn = jax.vjp(
-                lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
+        def last_stage(args):
+            # head/CE math is position-local (and its TP psums span
+            # same-pipe-rank devices only, which share this branch
+            # choice) — safe under the s_idx cond
+            yb, gl, loss = args
+            aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
+            li, last_vjp = jax.vjp(
+                lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
+            dlp, dy = last_vjp(jnp.ones((), li.dtype))
+            gl = jax.tree.map(
+                lambda g, d: g + jnp.where(b_on, d, jnp.zeros_like(d)),
+                gl, dlp)
+            return dy, gl, loss + jnp.where(b_on, li, 0.0)
 
-            def last_stage(args):
-                yb, gl, loss = args
-                aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
-                li, last_vjp = jax.vjp(
-                    lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
-                dlp, dy = last_vjp(jnp.ones((), li.dtype))
-                return dy, jax.tree.map(jnp.add, gl, dlp), loss + li
+        def mid_stage(args):
+            yb, gl, loss = args
+            return bwd_msg.astype(yb.dtype), gl, loss
 
-            def mid_stage(args):
-                yb, gl, loss = args
-                return bwd_msg.astype(yb.dtype), gl, loss
-
-            dy, gl, loss = lax.cond(s_idx == n - 1, last_stage, mid_stage,
-                                    (yb, gl, loss))
-            dsp, dx = vjp_fn(dy)
-            gs = jax.tree.map(jnp.add, gs, dsp)
-            # only stage 0's input cotangents are the embedding stream's
-            dx_out = lax.cond(
-                s_idx == 0,
-                lambda d: lax.dynamic_update_index_in_dim(
-                    d, dx.astype(f32), i_b, 0),
-                lambda d: d, dx_out)
-            return dx.astype(fwd_msg.dtype), stash, gs, gl, loss, dx_out
-
-        dx_send, stash, gs, gl, loss, dx_out = lax.cond(
-            b_on, bwd_branch,
-            lambda c: (jnp.zeros(x_shape, fwd_msg.dtype),) + c[1:6],
-            (bwd_msg, stash, gs, gl, loss, dx_out, i_b))
+        dy, gl, loss = lax.cond(s_idx == n - 1, last_stage, mid_stage,
+                                (yb, gl, loss))
+        dsp, dx = vjp_fn(dy)
+        gs = jax.tree.map(
+            lambda g, d: g + jnp.where(b_on, d, jnp.zeros_like(d)),
+            gs, dsp)
+        # only stage 0's input cotangents are the embedding stream's
+        # (collective-free update: slot-gating is safe and skips the
+        # full-buffer select on the P-1 other stages)
+        dx_out = lax.cond(
+            b_on & (s_idx == 0),
+            lambda d: lax.dynamic_update_index_in_dim(
+                d, dx.astype(f32), i_b, 0),
+            lambda d: d, dx_out)
+        dx_send = jnp.where(b_on, dx.astype(fwd_msg.dtype),
+                            jnp.zeros(x_shape, fwd_msg.dtype))
 
         perm_f = [(j, (j + 1) % n) for j in range(n)]
         perm_b = [(j, (j - 1) % n) for j in range(n)]

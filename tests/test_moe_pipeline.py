@@ -992,3 +992,95 @@ class TestPipelineSP:
             bert_pipeline.PipelinedBertMlm(self.CFG, mesh=mesh_ps,
                                            num_microbatches=2,
                                            schedule="1f1b")
+
+
+class TestOneFOneBSP:
+    """1F1B + SP (ce_positions='all' — the position-local CE): the
+    in-schedule head math runs on seq-sharded activations with local
+    sums + a seq psum; parity with GPipe+SP is the correctness pin."""
+
+    CFG = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                          mlp=64, max_positions=32, dropout=0.0,
+                          ce_positions="all")
+
+    @pytest.fixture(scope="class")
+    def mesh_ps(self):
+        return meshlib.make_mesh({"pipe": 2, "seq": 2, "data": 2})
+
+    def _batch(self, cfg, n=8, seq=16, seed=0):
+        tokens, targets, mask = synthetic.mlm_batches(
+            n, seq_len=seq, vocab_size=cfg.vocab_size, seed=seed)
+        return {"tokens": tokens, "mask": mask}, targets
+
+    def _models(self, mesh, cfg=None):
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        cfg = cfg or self.CFG
+        gp = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh,
+                                            num_microbatches=2)
+        ob = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh,
+                                            num_microbatches=2,
+                                            schedule="1f1b")
+        params = gp.init(jax.random.key(0))
+        params = sharding_rules.shard_tree(params, gp.logical_axes(), mesh)
+        return gp, ob, params
+
+    def test_loss_and_grads_match_gpipe_under_sp(self, mesh_ps):
+        gp, ob, params = self._models(mesh_ps)
+        batch, targets = self._batch(self.CFG)
+        l_gp, _ = gp.loss(params, None, batch, targets, train=True)
+        l_ob, _ = ob.loss(params, None, batch, targets, train=True)
+        np.testing.assert_allclose(float(l_gp), float(l_ob), rtol=1e-5)
+        g_gp = jax.grad(lambda p: gp.loss(p, None, batch, targets,
+                                          train=True)[0])(params)
+        g_ob = jax.grad(lambda p: ob.loss(p, None, batch, targets,
+                                          train=True)[0])(params)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
+            g_gp, g_ob)
+
+    def test_dropout_masks_identical_across_schedules_under_sp(self,
+                                                               mesh_ps):
+        """With dropout on and the same key, both schedules must draw
+        IDENTICAL per-(data, seq)-shard masks — the shard fold formulas
+        are pinned to each other."""
+        import dataclasses as dc
+
+        cfg = dc.replace(self.CFG, dropout=0.3)
+        gp, ob, params = self._models(mesh_ps, cfg)
+        batch, targets = self._batch(cfg)
+        key = jax.random.key(5)
+        l_gp, _ = gp.loss(params, None, batch, targets, rng=key,
+                          train=True)
+        l_ob, _ = ob.loss(params, None, batch, targets, rng=key,
+                          train=True)
+        np.testing.assert_allclose(float(l_gp), float(l_ob), rtol=1e-5)
+
+    def test_causal_1f1b_sp_matches_plain(self, mesh_ps):
+        from mpi_tensorflow_tpu.models import bert_pipeline, gpt
+
+        plain = gpt.CausalLm(self.CFG)
+        params = plain.init(jax.random.key(0))
+        piped = gpt.PipelinedCausalLm(self.CFG, mesh=mesh_ps,
+                                      num_microbatches=2,
+                                      schedule="1f1b")
+        pparams = dict(params)
+        pparams["layers"] = bert_pipeline.stack_layers(params["layers"], 2)
+        pparams = sharding_rules.shard_tree(pparams, piped.logical_axes(),
+                                            mesh_ps)
+        toks = self._batch(self.CFG)[0]["tokens"]
+        l_plain, _ = plain.loss(params, None, {"tokens": toks}, None)
+        l_pipe, _ = piped.loss(pparams, None, {"tokens": toks}, None,
+                               train=True)
+        np.testing.assert_allclose(float(l_plain), float(l_pipe),
+                                   rtol=2e-5)
+
+    def test_masked_packing_still_rejected(self, mesh_ps):
+        import dataclasses as dc
+
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        with pytest.raises(ValueError, match="ce_positions"):
+            bert_pipeline.PipelinedBertMlm(
+                dc.replace(self.CFG, ce_positions="masked"), mesh=mesh_ps,
+                num_microbatches=2, schedule="1f1b")
