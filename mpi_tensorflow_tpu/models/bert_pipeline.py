@@ -493,8 +493,13 @@ class PipelinedBertMlm(bert_lib.BertMlm):
                 return plain._mb_loss(hp, y, labels_i, mask_i, inv,
                                       tp_axis=tp_axis)
 
+            # stage bodies carry collectives whenever TP or SP is inside
+            # them — those meshes need uniform (unconditional) stage
+            # execution; plain pipe x data keeps the slot-gated fast path
             loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b(
-                stage_fn, last_fn, sp_params, hp, mb, (lab, msk), "pipe")
+                stage_fn, last_fn, sp_params, hp, mb, (lab, msk), "pipe",
+                uniform_stages=(tp_axis is not None
+                                or seq_axis is not None))
             gl = _reduce_partials(gl, hp_specs)
             gs = _reduce_partials(gs, sp_specs)
             if tp_axis is not None:

@@ -140,7 +140,7 @@ def schedule_table(n_stages: int, num_microbatches: int) -> list:
 
 def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
                   last_params: Any, microbatches, mb_aux: Any,
-                  axis: str = "pipe"):
+                  axis: str = "pipe", *, uniform_stages: bool = True):
     """Interleaved one-forward-one-backward pipeline schedule.
 
     Inside ``shard_map`` with ``axis`` in scope.  Per pipe shard:
@@ -158,6 +158,18 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
       ``_reduce_partials``).
     - ``microbatches``: (M, mb, ...) — the SAME full stream on every pipe
       shard.  ``mb_aux``: pytree with leading M axis (labels/masks/...).
+    - ``uniform_stages``: MUST be True whenever ``stage_fn`` contains
+      collectives over mesh axes other than ``axis`` (ring attention's
+      ppermute over 'seq', TP psums over 'model'): those collectives'
+      groups span devices whose slot predicates agree, but placing them
+      under a pipe-rank-dependent ``lax.cond`` is unsound regardless — a
+      minimal repro crashes XLA:CPU's thunk executor, and the full model
+      silently computed a wrong seq-sharded forward.  True runs the
+      stage body and its vjp unconditionally every tick and masks the
+      results (GPipe's scan always worked this way).  False keeps the
+      slot-gated ``lax.cond`` fast path — valid ONLY for collective-free
+      stages (plain pipe x data), where it skips the bubble-tick
+      compute.
 
     Returns ``(loss, d_stage_params, d_last_params, d_microbatches)`` —
     loss/d_last/d_micro are summed over ``axis`` (zeros contributed by
@@ -173,75 +185,94 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
     def tick_fn(carry, t):
         fwd_msg, bwd_msg, stash, gs, gl, loss, dx_out = carry
         # forward: stage s OWNS microbatch (t-s)/2 when parity/range fit.
-        # The stage body runs UNCONDITIONALLY every tick and its result
-        # is masked by f_on: the stage may contain collectives (ring
-        # attention's ppermute over 'seq', TP psums over 'model') whose
-        # groups span devices with the SAME pipe rank on OTHER mesh
-        # axes — a lax.cond on the pipe-dependent slot predicate would
-        # put those collectives under control flow and is UNSOUND (the
-        # minimal repro crashes XLA:CPU's thunk executor; in the full
-        # model it silently corrupted the seq-sharded forward).  GPipe's
-        # pipeline() already runs stages unconditionally; this schedule
-        # now matches, paying bubble-tick compute for collective
-        # uniformity while keeping the O(P) stash that is its point.
+        # Under ``uniform_stages`` the stage body runs UNCONDITIONALLY
+        # every tick and its result is masked by f_on: the stage may
+        # contain collectives (ring attention's ppermute over 'seq', TP
+        # psums over 'model') and a lax.cond on the pipe-dependent slot
+        # predicate would put them under control flow — UNSOUND (the
+        # minimal repro crashes XLA:CPU's thunk executor; the full model
+        # silently corrupted the seq-sharded forward).  GPipe's
+        # pipeline() already runs stages unconditionally; the gated
+        # fast path below remains for collective-free stages only.
         f_num = t - s_idx
         i_f = jnp.clip(f_num // 2, 0, m - 1)
         f_on = (f_num >= 0) & (f_num % 2 == 0) & (f_num // 2 < m)
         x_in = jnp.where(s_idx == 0,
                          microbatches[i_f].astype(fwd_msg.dtype), fwd_msg)
-        y_all = stage_fn(stage_params, x_in, i_f)
-        y = jnp.where(f_on, y_all, jnp.zeros(x_shape, y_all.dtype))
-        # carry updates hold NO collectives — they may stay slot-gated
-        # (only the stage body must run unconditionally)
+        if uniform_stages:
+            y_all = stage_fn(stage_params, x_in, i_f)
+            y = jnp.where(f_on, y_all, jnp.zeros(x_shape, y_all.dtype))
+        else:
+            y = lax.cond(
+                f_on,
+                lambda xx: stage_fn(stage_params, xx, i_f),
+                lambda xx: jnp.zeros(x_shape, fwd_msg.dtype), x_in)
+        # carry updates hold NO collectives — always safely slot-gated
         stash = lax.cond(
             f_on,
             lambda s: lax.dynamic_update_index_in_dim(s, x_in, i_f % n, 0),
             lambda s: s, stash)
 
         # backward: stage s owns microbatch (t-(2n-1-s))/2.  Same rule:
-        # the stage replay (and its vjp — reverse ppermute hops) runs
-        # unconditionally; only the ACCUMULATIONS are masked by b_on.
+        # under uniform_stages the stage replay (and its vjp — reverse
+        # ppermute hops) runs unconditionally; only the ACCUMULATIONS
+        # are masked by b_on.
         b_num = t - (2 * n - 1 - s_idx)
         i_b = jnp.clip(b_num // 2, 0, m - 1)
         b_on = (b_num >= 0) & (b_num % 2 == 0) & (b_num // 2 < m)
-        x = stash[i_b % n]
-        yb, vjp_fn = jax.vjp(
-            lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
 
-        def last_stage(args):
-            # head/CE math is position-local (and its TP psums span
-            # same-pipe-rank devices only, which share this branch
-            # choice) — safe under the s_idx cond
-            yb, gl, loss = args
-            aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
-            li, last_vjp = jax.vjp(
-                lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
-            dlp, dy = last_vjp(jnp.ones((), li.dtype))
-            gl = jax.tree.map(
-                lambda g, d: g + jnp.where(b_on, d, jnp.zeros_like(d)),
-                gl, dlp)
-            return dy, gl, loss + jnp.where(b_on, li, 0.0)
+        def bwd_math(c):
+            """The shared backward body: stage replay + head-or-message
+            cotangent + vjp.  Accumulations masked by ``gate`` (constant
+            True on the gated path — the cond already gates)."""
+            bwd_msg, stash, gs, gl, loss, dx_out, gate = c
+            x = stash[i_b % n]
+            yb, vjp_fn = jax.vjp(
+                lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
 
-        def mid_stage(args):
-            yb, gl, loss = args
-            return bwd_msg.astype(yb.dtype), gl, loss
+            def last_stage(args):
+                # head/CE math is position-local (and its TP psums span
+                # same-pipe-rank devices only, which share this branch
+                # choice) — safe under the s_idx cond
+                yb, gl, loss = args
+                aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
+                li, last_vjp = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
+                dlp, dy = last_vjp(jnp.ones((), li.dtype))
+                gl = jax.tree.map(
+                    lambda g, d: g + jnp.where(gate, d, jnp.zeros_like(d)),
+                    gl, dlp)
+                return dy, gl, loss + jnp.where(gate, li, 0.0)
 
-        dy, gl, loss = lax.cond(s_idx == n - 1, last_stage, mid_stage,
-                                (yb, gl, loss))
-        dsp, dx = vjp_fn(dy)
-        gs = jax.tree.map(
-            lambda g, d: g + jnp.where(b_on, d, jnp.zeros_like(d)),
-            gs, dsp)
-        # only stage 0's input cotangents are the embedding stream's
-        # (collective-free update: slot-gating is safe and skips the
-        # full-buffer select on the P-1 other stages)
-        dx_out = lax.cond(
-            b_on & (s_idx == 0),
-            lambda d: lax.dynamic_update_index_in_dim(
-                d, dx.astype(f32), i_b, 0),
-            lambda d: d, dx_out)
-        dx_send = jnp.where(b_on, dx.astype(fwd_msg.dtype),
-                            jnp.zeros(x_shape, fwd_msg.dtype))
+            def mid_stage(args):
+                yb, gl, loss = args
+                return bwd_msg.astype(yb.dtype), gl, loss
+
+            dy, gl, loss = lax.cond(s_idx == n - 1, last_stage, mid_stage,
+                                    (yb, gl, loss))
+            dsp, dx = vjp_fn(dy)
+            gs = jax.tree.map(
+                lambda g, d: g + jnp.where(gate, d, jnp.zeros_like(d)),
+                gs, dsp)
+            # only stage 0's input cotangents are the embedding stream's
+            dx_out = lax.cond(
+                gate & (s_idx == 0),
+                lambda d: lax.dynamic_update_index_in_dim(
+                    d, dx.astype(f32), i_b, 0),
+                lambda d: d, dx_out)
+            dx_send = jnp.where(gate, dx.astype(fwd_msg.dtype),
+                                jnp.zeros(x_shape, fwd_msg.dtype))
+            return dx_send, stash, gs, gl, loss, dx_out
+
+        if uniform_stages:
+            dx_send, stash, gs, gl, loss, dx_out = bwd_math(
+                (bwd_msg, stash, gs, gl, loss, dx_out, b_on))
+        else:
+            dx_send, stash, gs, gl, loss, dx_out = lax.cond(
+                b_on,
+                lambda c: bwd_math(c),
+                lambda c: (jnp.zeros(x_shape, fwd_msg.dtype),) + c[1:6],
+                (bwd_msg, stash, gs, gl, loss, dx_out, jnp.bool_(True)))
 
         perm_f = [(j, (j + 1) % n) for j in range(n)]
         perm_b = [(j, (j - 1) % n) for j in range(n)]

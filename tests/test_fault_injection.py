@@ -61,21 +61,15 @@ class TestTransientClassification:
 
 
 def _cli_env():
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)   # force local CPU backend
-    env["JAX_PLATFORMS"] = "cpu"
-    env["JAX_NUM_CPU_DEVICES"] = "8"
-    env["PYTHONUNBUFFERED"] = "1"
-    from mpi_tensorflow_tpu.utils.cache import gated_cpu_cache
+    # the canonical forced-CPU incantation (cache gating + collective
+    # rendezvous timeouts + platform forcing) lives in ONE place
+    from __graft_entry__ import _force_virtual_cpu_env
 
-    # host-scoped AND round-trip-gated: a foreign-machine AOT entry can
-    # SIGILL, and some boxes cannot reload their OWN entries — the CLI
-    # children must never open that hazard (utils/cache.py)
-    scoped = gated_cpu_cache(os.path.join(REPO, ".jax_cache"))
-    if scoped is not None:
-        env["JAX_COMPILATION_CACHE_DIR"] = scoped
-    else:
-        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    _force_virtual_cpu_env(env, 8)
+    env["PYTHONUNBUFFERED"] = "1"
     return env
 
 
