@@ -570,10 +570,13 @@ def _stale_score(args, d: dict, item=None):
             return None
         if abs(d.get("payload_mb", 0) - args.payload_mb) > 1e-6:
             return None          # a different payload is a different metric
-        score = 1
-        if "chain" in d:     # the tunnel-robust (chained-scan) method
-            score += 1
-        return score
+        if "chain" not in d:
+            # rows from the retired per-dispatch method are the very
+            # tunnel-overhead artifact the chained-scan method supersedes
+            # — reject them outright, like the decode branch rejects
+            # pre-roofline degenerate rows
+            return None
+        return 1
     if d.get("model") != args.model:
         return None
     spec = MODEL_SPECS[args.model]

@@ -230,26 +230,50 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
             yb, vjp_fn = jax.vjp(
                 lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
 
-            def last_stage(args):
-                # head/CE math is position-local (and its TP psums span
-                # same-pipe-rank devices only, which share this branch
-                # choice) — safe under the s_idx cond
-                yb, gl, loss = args
+            def head_math(yb):
                 aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
                 li, last_vjp = jax.vjp(
                     lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
                 dlp, dy = last_vjp(jnp.ones((), li.dtype))
+                return li, dlp, dy
+
+            if uniform_stages:
+                # ``last_fn`` may itself contain collectives over OTHER
+                # mesh axes (vocab-parallel CE's psum/all_gather over
+                # 'model').  The ``s_idx == n-1`` predicate varies across
+                # pipe ranks, so putting those collectives under a cond is
+                # the same unsound pattern the uniform path exists to
+                # avoid (each 'model' psum group is branch-uniform today,
+                # but that is fragile across XLA versions).  Run the head
+                # math unconditionally and mask by rank+slot instead.
+                li, dlp, dy_head = head_math(yb)
+                on_last = gate & (s_idx == n - 1)
                 gl = jax.tree.map(
-                    lambda g, d: g + jnp.where(gate, d, jnp.zeros_like(d)),
+                    lambda g, d: g + jnp.where(on_last, d,
+                                               jnp.zeros_like(d)),
                     gl, dlp)
-                return dy, gl, loss + jnp.where(gate, li, 0.0)
+                loss = loss + jnp.where(on_last, li, 0.0)
+                dy = jnp.where(s_idx == n - 1, dy_head,
+                               bwd_msg.astype(dy_head.dtype))
+            else:
+                def last_stage(args):
+                    # gated path: stages are collective-free by contract,
+                    # and the head's TP psums (if any) would span
+                    # same-pipe-rank devices that share this branch
+                    yb, gl, loss = args
+                    li, dlp, dy = head_math(yb)
+                    gl = jax.tree.map(
+                        lambda g, d: g + jnp.where(gate, d,
+                                                   jnp.zeros_like(d)),
+                        gl, dlp)
+                    return dy, gl, loss + jnp.where(gate, li, 0.0)
 
-            def mid_stage(args):
-                yb, gl, loss = args
-                return bwd_msg.astype(yb.dtype), gl, loss
+                def mid_stage(args):
+                    yb, gl, loss = args
+                    return bwd_msg.astype(yb.dtype), gl, loss
 
-            dy, gl, loss = lax.cond(s_idx == n - 1, last_stage, mid_stage,
-                                    (yb, gl, loss))
+                dy, gl, loss = lax.cond(s_idx == n - 1, last_stage,
+                                        mid_stage, (yb, gl, loss))
             dsp, dx = vjp_fn(dy)
             gs = jax.tree.map(
                 lambda g, d: g + jnp.where(gate, d, jnp.zeros_like(d)),

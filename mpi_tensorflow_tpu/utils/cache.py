@@ -124,9 +124,13 @@ def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
     verdict_path = f"{os.path.normpath(scoped_dir)}.{ver}.roundtrip"
     if os.path.exists(verdict_path):
         with open(verdict_path) as f:
-            safe = f.read().strip() == "safe"
-        _ROUNDTRIP_MEMO[memo_key] = safe
-        return safe
+            content = f.read().strip()
+        if content in ("safe", "unsafe"):
+            safe = content == "safe"
+            _ROUNDTRIP_MEMO[memo_key] = safe
+            return safe
+        # torn/garbage file (e.g. a reader raced a non-atomic writer from
+        # an older version): fall through and re-probe
 
     import subprocess
     import tempfile
@@ -160,8 +164,13 @@ def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
 
         shutil.rmtree(cache, ignore_errors=True)
     if verdict is not None:
-        with open(verdict_path, "w") as f:
+        # atomic publish: a reader racing the write must see the old
+        # state or the full verdict, never a torn file ('' != 'safe'
+        # would silently disable the cache for this jaxlib version)
+        tmp = f"{verdict_path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
             f.write(verdict)
+        os.replace(tmp, verdict_path)
     safe = verdict == "safe"
     _ROUNDTRIP_MEMO[memo_key] = safe
     return safe
