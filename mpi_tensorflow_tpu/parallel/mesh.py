@@ -22,6 +22,22 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 
+def _distributed_client_active() -> bool:
+    """Whether ``jax.distributed.initialize`` has already run.
+
+    Must NOT call ``jax.process_count()``: that initializes the XLA
+    backend, after which ``jax.distributed.initialize`` is a hard error —
+    the old guard made every real (non-monkeypatched) multi-process
+    bring-up fail.  Found by the 2-process bring-up test
+    (tests/test_distributed_bringup.py)."""
+    try:
+        from jax._src import distributed as _dist
+
+        return _dist.global_state.client is not None
+    except Exception:        # private-API drift: fall back, accept the cost
+        return jax.process_count() > 1
+
+
 def initialize_distributed(coordinator_address: Optional[str] = None,
                            num_processes: Optional[int] = None,
                            process_id: Optional[int] = None) -> None:
@@ -31,7 +47,7 @@ def initialize_distributed(coordinator_address: Optional[str] = None,
     with no arguments is correct there.  Safe no-op for single-process runs
     and when already initialized.
     """
-    if jax.process_count() > 1:
+    if _distributed_client_active():
         return  # already initialized
     explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
     auto_env = any(v in os.environ for v in

@@ -164,7 +164,11 @@ def snapshot_sharded(state: Any) -> tuple[list, dict]:
                 jobs.append((fname, np.asarray(x)))
             elif key in local and local[key].device == dev:
                 jobs.append((fname, np.asarray(local[key].data)))
-        dtype = np.dtype(getattr(x, "dtype", np.asarray(x).dtype))
+        # NOT getattr(x, "dtype", np.asarray(x).dtype): the default is
+        # evaluated eagerly, and fetching a cross-process global array
+        # raises — found by the real 2-process bring-up test
+        dtype = (np.dtype(x.dtype) if hasattr(x, "dtype")
+                 else np.asarray(x).dtype)
         leaf_meta.append({"shape": list(shape), "dtype": dtype.str,
                           "shards": shards})
     return jobs, {"num_leaves": len(leaves), "leaves": leaf_meta}

@@ -168,9 +168,15 @@ def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
         # state or the full verdict, never a torn file ('' != 'safe'
         # would silently disable the cache for this jaxlib version)
         tmp = f"{verdict_path}.tmp.{os.getpid()}"
-        with open(tmp, "w") as f:
-            f.write(verdict)
-        os.replace(tmp, verdict_path)
+        try:
+            with open(tmp, "w") as f:
+                f.write(verdict)
+            os.replace(tmp, verdict_path)
+        except OSError:
+            try:
+                os.unlink(tmp)       # no stray tmp on ENOSPC/races
+            except OSError:
+                pass
     safe = verdict == "safe"
     _ROUNDTRIP_MEMO[memo_key] = safe
     return safe

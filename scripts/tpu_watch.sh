@@ -20,7 +20,10 @@ while :; do
   fi
   if probe; then
     echo "### watch: tunnel UP, firing queue $(date -u +%FT%TZ)" >> "$LOG"
-    timeout 7200 python scripts/tpu_round3.py >> /tmp/tpu_round3.out 2>&1
+    # 3600s outer timeout: a hung tunnel RPC inside one item (observed
+    # r5: 48min silent stall on bert_fused_qkv) costs at most an hour;
+    # stamps make restarts cheap, so a lower bound beats a wasted window
+    timeout 3600 python scripts/tpu_round3.py >> /tmp/tpu_round3.out 2>&1
     echo "### watch: queue run ended rc=$? $(date -u +%FT%TZ)" >> "$LOG"
   else
     echo "### watch: tunnel down $(date -u +%FT%TZ)" >> "$LOG"

@@ -1,0 +1,150 @@
+"""Worker for the real 2-process ``jax.distributed`` bring-up test.
+
+Spawned by tests/test_distributed_bringup.py.  The parent sets
+``JAX_PLATFORMS=cpu`` and ``--xla_force_host_platform_device_count=<k>``
+in the child environment BEFORE exec (a sitecustomize imports jax at
+interpreter start, so the platform choice cannot be made here).
+
+This is the reference's actual execution model — N OS processes joining
+one world (``mpiexec -n N``, mpipy.py:208-210, 236-241) — run for real:
+no monkeypatched ``jax.process_index``/``process_count`` anywhere.
+Covers: ``initialize_distributed`` -> cross-process device mesh ->
+``host_shard`` per-host data -> one psum train step on the reference CNN
+-> the agreed-stop allgather -> sharded save from both processes ->
+restore onto a different mesh layout.
+
+Writes a JSON result line to ``<outdir>/result_<pid>.json``; the parent
+asserts on both files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    outdir = sys.argv[4]
+
+    import jax
+    import numpy as np
+
+    from mpi_tensorflow_tpu.parallel import mesh as meshlib
+
+    # the real bring-up — this must run before any backend use
+    meshlib.initialize_distributed(coordinator_address=coord,
+                                   num_processes=nprocs, process_id=pid)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = {
+        "process_index": int(jax.process_index()),
+        "process_count": int(jax.process_count()),
+        "device_count": int(jax.device_count()),
+        "local_device_count": int(jax.local_device_count()),
+    }
+
+    # one mesh spanning both processes' devices
+    mesh = meshlib.make_mesh({"data": jax.device_count()})
+
+    # per-host contiguous data slices (the Scatter equivalent, SURVEY §5):
+    # both hosts hold the same source stream; each keeps only its slice
+    from mpi_tensorflow_tpu.data import sharding as hostshard
+
+    rng = np.random.default_rng(0)
+    full_x = rng.normal(size=(32, 28, 28, 1)).astype(np.float32) * 0.3
+    full_y = rng.integers(0, 10, size=(32,)).astype(np.int64)
+    lx = hostshard.host_shard(full_x)
+    ly = hostshard.host_shard(full_y)
+    out["local_rows"] = int(lx.shape[0])
+
+    gx = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), lx)
+    gy = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("data")), ly)
+
+    # one real psum train step on the reference CNN across both processes
+    from mpi_tensorflow_tpu.config import Config
+    from mpi_tensorflow_tpu.models import cnn
+    from mpi_tensorflow_tpu.train import step as steplib
+
+    cfg = Config(batch_size=32, dropout_rate=0.0)
+    model = cnn.MnistCnn(dropout_rate=0.0)
+    state = steplib.init_state(model, jax.random.key(1))
+    train_step = steplib.make_train_step(model, cfg, mesh, decay_steps=100)
+    def local_value(x):
+        # a global array on a cross-process mesh is not fully addressable;
+        # read this process's replica/shard instead of fetching the whole
+        if hasattr(x, "addressable_shards"):
+            return np.asarray(x.addressable_shards[0].data)
+        return np.asarray(x)
+
+    state, metrics = train_step(state, gx, gy, jax.random.key(0))
+    out["loss"] = float(local_value(metrics["loss"]))
+    out["opt_step"] = float(local_value(state.opt.step))
+
+    # agreed-stop: only process 1 observes a "signal"; the allgather must
+    # make BOTH processes agree to stop at the same trace point
+    from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
+
+    hooks = CheckpointHooks(os.path.join(outdir, "ckpt"), verbose=False)
+    if hooks.guard is not None and pid == 1:
+        hooks.guard.request_stop("bringup-test")
+    out["stop_now_suppressed"] = not hooks.stop_now(1)   # multi-host: False
+    out["stop_agreed"] = bool(hooks.stop_agreed(1))
+
+    # sharded save: every process writes its own shard files, process 0
+    # commits meta.json after the cross-process barrier
+    from mpi_tensorflow_tpu.train import checkpoint
+
+    ckpt = os.path.join(outdir, "bringup_ckpt")
+    save_state = {"params": state.params, "batchlike": gx}
+    checkpoint.save_sharded(ckpt, save_state, step=1)
+    # the commit marker is written by process 0 AFTER the barrier —
+    # non-zero processes may return from save_sharded before it lands,
+    # so poll (the marker's absence-until-commit is the crash-safety
+    # contract, not a bug)
+    import time
+
+    meta_path = os.path.join(ckpt + ".sharded", "meta.json")
+    deadline = time.time() + 60
+    while not os.path.exists(meta_path) and time.time() < deadline:
+        time.sleep(0.2)
+    out["meta_committed"] = os.path.exists(meta_path)
+
+    # restore onto a DIFFERENT layout: params stay replicated, but the
+    # data-sharded leaf comes back split over a 2-axis mesh's 'model'
+    # axis — each device's slice crosses the process boundary the shards
+    # were written under
+    mesh2 = meshlib.make_mesh({"data": 2, "model": jax.device_count() // 2})
+    template = {
+        "params": jax.tree.map(
+            lambda x: jax.device_put(x, NamedSharding(mesh2, P())),
+            state.params),
+        "batchlike": jax.device_put(
+            jax.numpy.zeros_like(gx), NamedSharding(mesh2, P("model"))),
+    }
+    restored, meta = checkpoint.restore_sharded(ckpt, template)
+    # verify every ADDRESSABLE shard of the re-laid-out leaf against the
+    # original host stream (its global index names the expected rows)
+    for sh in restored["batchlike"].addressable_shards:
+        np.testing.assert_allclose(
+            np.asarray(sh.data), full_x[sh.index], rtol=0, atol=0)
+    for k in state.params:
+        np.testing.assert_allclose(
+            local_value(restored["params"][k]),
+            local_value(state.params[k]), rtol=0, atol=0)
+    out["restore_ok"] = True
+    out["restored_step"] = meta["step"]
+
+    hooks.close()
+    with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
+        json.dump(out, f)
+
+
+if __name__ == "__main__":
+    main()
