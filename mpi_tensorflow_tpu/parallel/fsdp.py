@@ -91,6 +91,38 @@ def shard_params(params: Any, mesh: Mesh, specs: Any) -> Any:
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs)
 
 
+def zero1_shard_opt(opt_state: Any, mesh: Mesh, axis: str = "data",
+                    min_size: int = DEFAULT_MIN_SIZE) -> Any:
+    """ZeRO-1: re-place an optimizer state with every moment tensor
+    additionally sharded over ``axis``, leaving the PARAMETERS' layout
+    untouched.
+
+    This is the composition the manual pipeline schedules need: stage
+    params must keep their ``pipe``-sharded, data-replicated placement
+    (the 1F1B/GPipe ``shard_map`` in_specs are a contract about layout),
+    but the Adam moments — 2x param memory — only appear in the optax
+    update OUTSIDE the schedule, at the GSPMD level, where XLA inserts
+    the grad reduce-scatter into the moment shards and the update
+    all-gather back to the replicated params automatically.
+
+    Works on any optax state with no param-tree bookkeeping: ``tx.init``
+    builds moments via ``zeros_like(param)``, which PRESERVES each
+    param's NamedSharding — so augmenting every array leaf's own spec
+    with ``axis`` yields exactly "param layout + data", TP/PP axes
+    included.  Scalars (step counts) and already-``axis``-sharded leaves
+    pass through unchanged."""
+    def place(x):
+        sh = getattr(x, "sharding", None)
+        if not isinstance(sh, NamedSharding) or getattr(x, "ndim", 0) == 0:
+            return x
+        spec = augment_spec(sh.spec, x.shape, mesh, axis, min_size)
+        if spec == sh.spec:
+            return x
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, opt_state)
+
+
 def state_out_shardings(state: Any):
     """Derive jit ``out_shardings`` from an already-placed state pytree —
     pins parameters AND optimizer moments back to their FSDP shards after

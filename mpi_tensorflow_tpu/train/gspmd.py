@@ -106,6 +106,28 @@ def init_fsdp_state(model, tx: optax.GradientTransformation, rng,
     return GspmdState(params, opt, mstate, step)
 
 
+def init_zero1_state(model, tx: optax.GradientTransformation, rng,
+                     mesh: Mesh, rules: Optional[dict] = None,
+                     axis: str = "data",
+                     min_size: int = fsdp_lib.DEFAULT_MIN_SIZE) -> GspmdState:
+    """ZeRO-1 initialization: parameters keep their rule-table placement
+    (pipe-sharded stages, TP axes, data-replicated weights) — so the
+    manual pipeline schedules' shard_map in_specs still hold — while the
+    optimizer moments are additionally sharded over ``axis``
+    (parallel/fsdp.py::zero1_shard_opt).  Pass the result as
+    ``state_template`` to pin the moments to their shards across steps."""
+    st = init_gspmd_state(model, tx, rng, mesh, rules)
+    # shard_tree leaves un-ruled leaves (layernorm scales, counters)
+    # unplaced; a state used as ``state_template`` must carry an explicit
+    # mesh placement on EVERY leaf or out_shardings conflicts
+    params = _place_replicated(st.params, mesh)
+    opt = fsdp_lib.zero1_shard_opt(_place_replicated(st.opt, mesh),
+                                   mesh, axis=axis, min_size=min_size)
+    mstate = _place_replicated(st.model_state, mesh)
+    step = jax.device_put(st.step, meshlib.replicated(mesh))
+    return GspmdState(params, opt, mstate, step)
+
+
 def grad_accum_dtype(opt_state) -> Optional[Any]:
     """Accumulation dtype for scanned microbatch gradients: fp32 when the
     optimizer keeps fp32 masters (live params — and thus per-microbatch

@@ -60,18 +60,27 @@ def test_two_process_bringup(tmp_path, devices_per_proc):
             text=True)
         for i in range(nprocs)
     ]
+    timed_out = False
     try:
         for p in procs:
-            p.wait(timeout=900)
+            try:
+                p.wait(timeout=900)
+            except subprocess.TimeoutExpired:
+                timed_out = True       # read the logs before failing —
+                break                  # they localize the hang
     finally:
         for p in procs:
             if p.poll() is None:
                 p.kill()
-    outs = []
-    for f in logs:
-        f.seek(0)
-        outs.append(f.read())
-        f.close()
+                p.wait()
+        outs = []
+        for f in logs:
+            f.seek(0)
+            outs.append(f.read())
+            f.close()
+    assert not timed_out, (
+        "workers timed out (cross-process hang?); logs:\n"
+        + "\n---\n".join(o[-2000:] for o in outs))
     for i, p in enumerate(procs):
         assert p.returncode == 0, (
             f"worker {i} rc={p.returncode}:\n{outs[i][-3000:]}")

@@ -36,8 +36,14 @@ LAYERS = 2      # full BERT-base width; 2 layers keep trace/compile cheap
 B, S = 8, 128
 
 
-@functools.lru_cache(maxsize=None)
 def _lowered(prng: str = "threefry", fused: bool = False):
+    # normalize to one cache key per (prng, fused): keyword vs positional
+    # spellings must not re-trace the same multi-second lowering
+    return _lowered_cached(prng, fused)
+
+
+@functools.lru_cache(maxsize=None)
+def _lowered_cached(prng: str, fused: bool):
     cfg = Config(precision="bf16", prng_impl=prng)
     # 1-device mesh: the program under pin is the SINGLE-CHIP flagship —
     # the same program the TPU queue times — not the conftest's 8-way
