@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Gated-vs-uniform 1F1B wall-clock A/B on a collective-free mesh.
+
+VERDICT r4 #4: ``uniform_stages=True`` (required whenever stage bodies
+carry collectives) runs the forward body and the backward replay+vjp
+every tick instead of only on scheduled slots.  ``schedule_cost``
+(parallel/pipeline.py) predicts the body-equivalent ratio
+``2*(M+P-1)/M`` vs the gated path's useful-work-only execution; this
+script measures the real wall-clock ratio for a matmul-heavy toy stage
+on the virtual CPU mesh and writes docs/PIPELINE_COST.md.
+
+Usage: python scripts/pipeline_cost_ab.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault(
+    "XLA_FLAGS", "") and None
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4")
+
+import jax                                # noqa: E402
+import jax.numpy as jnp                   # noqa: E402
+import numpy as np                        # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from mpi_tensorflow_tpu.parallel import pipeline  # noqa: E402
+
+
+def build(uniform: bool, Pst: int, M: int, mb: int, d: int):
+    mesh = jax.make_mesh((Pst,), ("pipe",), devices=jax.devices()[:Pst])
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.normal(size=(Pst, d, d)).astype(np.float32) * .2)
+    Wl = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
+    x = jnp.asarray(rng.normal(size=(M, mb, d)).astype(np.float32))
+    tgt = jnp.asarray(rng.normal(size=(M, mb, d)).astype(np.float32))
+
+    def stage_fn(w, h, mi):
+        return jnp.tanh(h @ w)
+
+    def last_fn(wl, y, aux):
+        return jnp.sum((y * wl - aux) ** 2) / (M * mb)
+
+    def run(W, Wl, x, tgt):
+        def inner(Wloc, Wl, x, tgt):
+            loss, gs, gl, dx = pipeline.pipeline_1f1b(
+                stage_fn, last_fn, Wloc[0], Wl, x, tgt, "pipe",
+                uniform_stages=uniform)
+            return loss, gs[None], gl, dx
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=(P("pipe"), P(), P(), P()),
+            out_specs=(P(), P("pipe"), P(), P()),
+            check_vma=False)(W, Wl, x, tgt)
+
+    fn = jax.jit(run)
+    args = (W, Wl, x, tgt)
+    jax.block_until_ready(fn(*args))      # compile + warm
+    return fn, args
+
+
+def timed(fn, args, iters: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    Pst, M, mb, d = 4, 8, 4, 512
+    iters = 30
+    rows = []
+    for uniform in (False, True):
+        fn, args = build(uniform, Pst, M, mb, d)
+        sec = timed(fn, args, iters)
+        pred = pipeline.schedule_cost(Pst, M, uniform)
+        rows.append((uniform, sec, pred))
+        print(f"uniform={uniform}: {sec*1e3:.2f} ms/pass "
+              f"(predicted body-equiv ratio {pred['overhead_ratio']:.2f})",
+              flush=True)
+    ratio = rows[1][1] / rows[0][1]
+    pred_ratio = rows[1][2]["overhead_ratio"] / rows[0][2]["overhead_ratio"]
+    doc = f"""# 1F1B schedule cost: gated vs uniform stages
+
+`uniform_stages=True` is REQUIRED whenever stage bodies or the head carry
+collectives over non-pipe mesh axes (TP psums, ring attention's seq
+ppermute, vocab-parallel CE): placing collectives under a pipe-rank-
+dependent `lax.cond` is unsound (r4 finding — XLA:CPU thunk crash,
+silently wrong seq-sharded forward).  The price, from
+`parallel/pipeline.schedule_cost` and measured on the virtual CPU mesh
+({Pst}-stage toy matmul pipeline, M={M}, mb={mb}, d={d}, {iters} iters):
+
+| schedule path | body-equiv per device (predicted) | measured ms/pass |
+|---|---|---|
+| gated (collective-free meshes) | {rows[0][2]['total_body_equiv']} (useful work only) | {rows[0][1]*1e3:.2f} |
+| uniform (collectives in stages) | {rows[1][2]['total_body_equiv']} ({rows[1][2]['overhead_ratio']:.2f}x useful) | {rows[1][1]*1e3:.2f} |
+
+Measured uniform/gated wall ratio: **{ratio:.2f}x** (predicted
+body-equivalent ratio {pred_ratio:.2f}x; wall clock sits below the pure
+compute ratio because ppermute hops, carry updates, and dispatch
+overheads are identical on both paths).
+
+Consequences:
+
+- On collective-free meshes (plain pipe x data) `pipeline_1f1b` keeps
+  the slot-gated fast path: no overhead vs the ideal schedule, plus the
+  O(P) activation stash.
+- With TP/SP inside stages the uniform path pays ~`2*(M+P-1)/M`x the
+  useful stage compute.  GPipe's scan pays `(M+P-1)/M`x on the forward
+  (its backward is autodiff of the same scan, so the ratio matches);
+  1F1B's advantage there is memory (O(P) vs O(M) stash), not compute.
+- Raising M amortizes both schedules' bubbles; the uniform overhead
+  falls toward 2x and the bubble toward 0.
+
+(Recorded by scripts/pipeline_cost_ab.py; re-run after schedule changes.)
+"""
+    with open(os.path.join(REPO, "docs", "PIPELINE_COST.md"), "w") as f:
+        f.write(doc)
+    print("wrote docs/PIPELINE_COST.md", flush=True)
+
+
+if __name__ == "__main__":
+    main()
