@@ -562,23 +562,13 @@ def measure_hostio(batch_size: int = 32, window_k: int = 4,
         # device demand: the latest recorded resnet50 TPU row, else the
         # round-3 headline (BASELINE.md: 1,617 img/s, b128+remat)
         demand, demand_src = 1617.0, "BASELINE.md r3 resnet50 b128+remat"
-        try:
-            with open(MEASURE_LOG) as f:
-                for line in f:
-                    if not line.startswith("{"):
-                        continue
-                    try:
-                        rec = json.loads(line)
-                    except ValueError:
-                        continue      # the log mixes watcher/legacy lines
-                    det = rec.get("detail") or {}
-                    if str(rec.get("item", "")).startswith("resnet50") \
-                            and det.get("platform") == "tpu" \
-                            and det.get("images_per_sec_per_chip"):
-                        demand = float(det["images_per_sec_per_chip"])
-                        demand_src = rec.get("item")
-        except OSError:
-            pass
+        for _, rec in _iter_measure_records():
+            det = rec.get("detail") or {}
+            if str(rec.get("item", "")).startswith("resnet50") \
+                    and det.get("platform") == "tpu" \
+                    and det.get("images_per_sec_per_chip"):
+                demand = float(det["images_per_sec_per_chip"])
+                demand_src = rec.get("item")
         out["device_demand_source"] = demand_src
         out.update(
             host_images_per_sec=best,
@@ -817,26 +807,22 @@ def _report(args, d: dict, stale: bool = False) -> int:
     return 0
 
 
-def _emit_stale(args):
-    """Tunnel-proof fallback (VERDICT r3 #1): when the accelerator probe
-    fails, emit the most recent real-TPU measurement for the requested
-    config from MEASURE_LOG.jsonl — marked ``stale`` with the original
-    (approximate) timestamp and the live-probe error — and exit 0, so the
-    driver artifact carries a real number regardless of tunnel state.
-    Returns 0 after emitting, None when no usable record exists."""
+def _iter_measure_records():
+    """THE one parser for the mixed watcher/JSON log format: yields
+    ``(line_idx, record)`` for every JSON record in MEASURE_LOG.jsonl,
+    attaching ``record["_near_ts"]`` — its own ``ts``, else the nearest
+    preceding watcher-line timestamp (the only dating round-3 rows
+    have).  Every consumer (stale fallback, hostio demand lookup) must
+    go through here so a log-format change is fixed once."""
     if not os.path.exists(MEASURE_LOG):
-        return None
+        return
     watch_ts = None
-    best = None          # (score, line_idx, record)
     with open(MEASURE_LOG) as f:
         for idx, line in enumerate(f):
             line = line.strip()
             if not line:
                 continue
             if line.startswith("#"):
-                # watcher comment lines carry the only timestamps the
-                # round-3 records have; the nearest preceding one bounds
-                # the record's age
                 m = re.search(r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}Z", line)
                 if m:
                     watch_ts = m.group(0)
@@ -845,15 +831,27 @@ def _emit_stale(args):
                 rec = json.loads(line)
             except ValueError:
                 continue
-            d = rec.get("detail") or {}
-            if d.get("platform") != "tpu":
-                continue
-            score = _stale_score(args, d, item=rec.get("item"))
-            if score is None:
-                continue
             rec["_near_ts"] = rec.get("ts") or watch_ts
-            if best is None or (score, idx) > (best[0], best[1]):
-                best = (score, idx, rec)
+            yield idx, rec
+
+
+def _emit_stale(args):
+    """Tunnel-proof fallback (VERDICT r3 #1): when the accelerator probe
+    fails, emit the most recent real-TPU measurement for the requested
+    config from MEASURE_LOG.jsonl — marked ``stale`` with the original
+    (approximate) timestamp and the live-probe error — and exit 0, so the
+    driver artifact carries a real number regardless of tunnel state.
+    Returns 0 after emitting, None when no usable record exists."""
+    best = None          # (score, line_idx, record)
+    for idx, rec in _iter_measure_records():
+        d = rec.get("detail") or {}
+        if d.get("platform") != "tpu":
+            continue
+        score = _stale_score(args, d, item=rec.get("item"))
+        if score is None:
+            continue
+        if best is None or (score, idx) > (best[0], best[1]):
+            best = (score, idx, rec)
     if best is None:
         return None
     _, _, rec = best

@@ -94,12 +94,17 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.prefetch,
                    help="background window assembly for the fused loop "
                         "(native = C++ worker, data/prefetch.py)")
-    p.add_argument("--pp-schedule", choices=["gpipe", "1f1b"],
+    p.add_argument("--pp-schedule",
+                   choices=["gpipe", "1f1b", "1f1b_interleaved"],
                    default=d.pp_schedule,
                    help="pipeline schedule for --mesh pipe=N runs: gpipe "
-                        "(autodiff backward) or 1f1b (interleaved "
-                        "one-forward-one-backward; same bubble, O(P) "
-                        "activation stash)")
+                        "(autodiff backward), 1f1b (one-forward-one-"
+                        "backward; same bubble, O(P) activation stash), "
+                        "or 1f1b_interleaved (--virtual-stages chunks per "
+                        "device; bubble shrinks ~v-fold)")
+    p.add_argument("--virtual-stages", type=int, default=d.virtual_stages,
+                   help="virtual chunks per device for "
+                        "--pp-schedule 1f1b_interleaved")
     p.add_argument("--grad-accum", type=int, default=d.grad_accum,
                    help="microbatches accumulated per optimizer step "
                         "(activation-memory / batch-size trade)")
@@ -147,6 +152,7 @@ def config_from_args(args) -> Config:
         precision=args.precision, prng_impl=args.prng,
         optimizer=args.optimizer, grad_accum=args.grad_accum,
         pp_schedule=args.pp_schedule,
+        virtual_stages=args.virtual_stages,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),

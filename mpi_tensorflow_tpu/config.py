@@ -74,9 +74,11 @@ class Config:
                                   # "thread", "off" (inline assembly)
     pp_schedule: str = "gpipe"    # pipeline-parallel training schedule:
                                   # "gpipe" (scanned fwd pipeline, autodiff
-                                  # backward) or "1f1b" (interleaved
-                                  # one-forward-one-backward — same bubble,
-                                  # O(P) stashed activations)
+                                  # backward), "1f1b" (one-forward-one-
+                                  # backward — same bubble, O(P) stash), or
+                                  # "1f1b_interleaved" (v virtual chunks
+                                  # per device: bubble / v, 2P-deep rings)
+    virtual_stages: int = 2       # chunks/device for "1f1b_interleaved"
     grad_accum: int = 1           # microbatches per step: grads accumulate
                                   # on-device (lax.scan) before the single
                                   # allreduce+update — same semantics, 1/A

@@ -111,6 +111,44 @@ def stack_layers(layers: list, num_stages: int):
             (num_stages, L // num_stages) + xs[0].shape), *layers)
 
 
+def stack_layers_interleaved(layers: list, num_stages: int, v: int):
+    """Interleaved chunk stacking: (P, v, L/(vP), ...) where
+    ``stacked[d, j]`` holds GLOBAL chunk ``k = j * P + d`` (layers
+    ``k*Lc .. (k+1)*Lc``) — chunks ascend round-robin over devices so
+    every pipeline hop is the +1 ring neighbor
+    (parallel/pipeline.pipeline_1f1b_interleaved)."""
+    L, P_ = len(layers), num_stages
+    V = v * P_
+    if L % V:
+        raise ValueError(f"{L} layers not divisible by {V} chunks "
+                         f"({P_} stages x {v} virtual)")
+    Lc = L // V
+
+    def stack(*xs):
+        flat = jnp.stack(xs)                       # (L, ...)
+        ch = flat.reshape((V, Lc) + xs[0].shape)   # chunk-major
+        # [k] -> [d, j] with k = j*P + d
+        return jnp.moveaxis(ch.reshape((v, P_, Lc) + xs[0].shape), 0, 1)
+
+    return jax.tree.map(stack, *layers)
+
+
+def unstack_interleaved(stacked, num_stages: int, v: int):
+    """Inverse layout map: (P, v, Lc, ...) -> GPipe's (P, v*Lc, ...)
+    stage-major order (stage s = chunks s*v .. s*v+v-1 = sequential
+    layers).  Pure jnp reshuffle — at the GSPMD level the compiler
+    inserts the pipe-axis data movement; used for the forward-only
+    (eval/encode) paths, which keep the GPipe scan."""
+    P_ = num_stages
+
+    def un(x):
+        Lc = x.shape[2]
+        ch = jnp.moveaxis(x, 0, 1).reshape((v * P_ * Lc,) + x.shape[3:])
+        return ch.reshape((P_, v * Lc) + x.shape[3:])
+
+    return jax.tree.map(un, stacked)
+
+
 @dataclasses.dataclass(frozen=True)
 class PipelinedBertMlm(bert_lib.BertMlm):
     """BERT-MLM with the encoder pipelined over the mesh's ``pipe`` axis.
@@ -124,12 +162,28 @@ class PipelinedBertMlm(bert_lib.BertMlm):
     (there is no backward to interleave with)."""
     num_microbatches: int = 4
     schedule: str = "gpipe"
+    virtual_stages: int = 1     # v chunks/device for "1f1b_interleaved"
 
     @property
     def _num_stages(self) -> int:
         return self.mesh.shape.get("pipe", 1) if self.mesh is not None else 1
 
+    @property
+    def _interleaved(self) -> bool:
+        return self.schedule == "1f1b_interleaved" and self.virtual_stages > 1
+
     def __post_init__(self):
+        if self.schedule not in ("gpipe", "1f1b", "1f1b_interleaved"):
+            raise ValueError(f"unknown schedule {self.schedule!r}")
+        if self.schedule == "1f1b_interleaved" and self.virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if self._interleaved and self.mesh is not None:
+            V = self._num_stages * self.virtual_stages
+            if self.cfg.layers % max(V, 1):
+                raise ValueError(
+                    f"{self.cfg.layers} layers not divisible by "
+                    f"{V} chunks ({self._num_stages} stages x "
+                    f"{self.virtual_stages} virtual)")
         if self.cfg.pos_kind != "learned":
             # the pipelined stage fn replicates the plain layer math
             # WITHOUT the rope rotation; guarding at CONSTRUCTION covers
@@ -139,7 +193,8 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             raise ValueError(
                 f"pipelined BERT supports pos_kind='learned' only "
                 f"(got {self.cfg.pos_kind!r})")
-        if self.schedule == "1f1b" and self.mesh is not None \
+        if self.schedule in ("1f1b", "1f1b_interleaved") \
+                and self.mesh is not None \
                 and self.mesh.shape.get("seq", 1) > 1 \
                 and self.cfg.ce_positions != "all":
             # the 1F1B path computes the head/CE INSIDE the schedule:
@@ -156,18 +211,25 @@ class PipelinedBertMlm(bert_lib.BertMlm):
 
     def init(self, rng):
         params = super().init(rng)
-        params["layers"] = stack_layers(params["layers"], self._num_stages)
+        if self._interleaved:
+            params["layers"] = stack_layers_interleaved(
+                params["layers"], self._num_stages, self.virtual_stages)
+        else:
+            params["layers"] = stack_layers(params["layers"],
+                                            self._num_stages)
         return params
 
     def logical_axes(self):
         axes = super().logical_axes()
         layer0 = axes["layers"][0]
-        axes["layers"] = {k: ("stage", "layer") + v
+        lead = ("stage", "vchunk", "layer") if self._interleaved \
+            else ("stage", "layer")
+        axes["layers"] = {k: lead + v
                           for k, v in layer0.items()
                           if not isinstance(v, dict)}
         for k, v in layer0.items():
             if isinstance(v, dict):   # layernorm sub-dicts
-                axes["layers"][k] = {kk: ("stage", "layer") + vv
+                axes["layers"][k] = {kk: lead + vv
                                      for kk, vv in v.items()}
         return axes
 
@@ -274,6 +336,12 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         return self._constrain(h, ("batch", "seq", "embed"))
 
     def _encode_aux(self, params, tokens, *, train: bool = False, rng=None):
+        if self._interleaved:
+            # forward-only paths keep the GPipe scan: fold the (P, v, Lc)
+            # chunk layout back to stage-major (P, v*Lc) — a pure jnp
+            # reshuffle whose pipe-axis data movement GSPMD inserts
+            params = dict(params, layers=unstack_interleaved(
+                params["layers"], self._num_stages, self.virtual_stages))
         dropping = self._dropping(train, rng)
         B, S = tokens.shape
         h = self._embed(params, tokens, dropping, rng)
@@ -328,23 +396,31 @@ class PipelinedBertMlm(bert_lib.BertMlm):
         key = rng if dropping else jax.random.key(0)
         h = jax.shard_map(
             inner, mesh=self.mesh,
-            in_specs=(self._stage_param_specs(), h_spec, P()),
+            in_specs=(self._stage_param_specs(gpipe_layout=True), h_spec,
+                      P()),
             out_specs=h_spec,
             check_vma=False)(params["layers"], h, key)
         h = self._constrain(h, ("batch", "seq", "embed"))
         return h, jnp.zeros((), jnp.float32)
 
-    def _stage_param_specs(self):
+    def _stage_param_specs(self, gpipe_layout: bool = False):
         """Per-leaf shard_map in_specs for the stacked stage params: the
         rule-table layout (stage -> pipe, heads/mlp -> model when the mesh
         has a model axis) — the specs must tell shard_map the truth about
         how ``shard_tree``/GSPMD placed the parameters, or TP-inside-stage
-        would silently gather."""
+        would silently gather.
+
+        ``gpipe_layout``: specs for the stage-major (P, v*Lc, ...) view
+        ``unstack_interleaved`` produces (the vchunk dim folded away);
+        no-op unless the model is interleaved."""
         from mpi_tensorflow_tpu.parallel import sharding_rules
 
-        return sharding_rules.tree_specs(
-            self.logical_axes()["layers"], self.mesh,
-            self.rules)
+        axes = self.logical_axes()["layers"]
+        if gpipe_layout and self._interleaved:
+            strip = lambda t: tuple(a for a in t if a != "vchunk")
+            axes = jax.tree.map(
+                strip, axes, is_leaf=lambda x: isinstance(x, tuple))
+        return sharding_rules.tree_specs(axes, self.mesh, self.rules)
 
     # ------------------------------------------------------------------
     # interleaved 1F1B training path
@@ -411,11 +487,14 @@ class PipelinedBertMlm(bert_lib.BertMlm):
 
     def loss(self, params, model_state, batch, labels, *, rng=None,
              train: bool = False):
-        if self.schedule != "1f1b" or self._num_stages == 1 or not train:
+        if self.schedule not in ("1f1b", "1f1b_interleaved") \
+                or self._num_stages == 1 or not train:
             bert_lib.engagement.record("pp_schedule", "gpipe")
             return super().loss(params, model_state, batch, labels,
                                 rng=rng, train=train)
-        bert_lib.engagement.record("pp_schedule", "1f1b")
+        bert_lib.engagement.record(
+            "pp_schedule",
+            "1f1b_interleaved" if self._interleaved else "1f1b")
 
         c = self.cfg
         tokens, mask = batch["tokens"], batch["mask"]
@@ -496,10 +575,25 @@ class PipelinedBertMlm(bert_lib.BertMlm):
             # stage bodies carry collectives whenever TP or SP is inside
             # them — those meshes need uniform (unconditional) stage
             # execution; plain pipe x data keeps the slot-gated fast path
-            loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b(
-                stage_fn, last_fn, sp_params, hp, mb, (lab, msk), "pipe",
-                uniform_stages=(tp_axis is not None
-                                or seq_axis is not None))
+            uniform = tp_axis is not None or seq_axis is not None
+            if self._interleaved:
+                def chunk_fn(p, x, mi, kg):
+                    # kg = GLOBAL chunk index: _stage derives the global
+                    # layer as stage_idx * Lp + li, and the chunk's Lp is
+                    # L/(vP) — masks match the gpipe/1f1b schedules
+                    return self._stage(p, x,
+                                       rng=key if dropping else None,
+                                       mb_idx=mi, stage_idx=kg,
+                                       tp_axis=tp_axis, seq_axis=seq_axis)
+
+                loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b_interleaved(
+                    chunk_fn, last_fn, sp_params, hp, mb, (lab, msk),
+                    "pipe", v=self.virtual_stages,
+                    n_stages=self._num_stages, uniform_stages=uniform)
+            else:
+                loss, gs, gl, dmb = pipeline_lib.pipeline_1f1b(
+                    stage_fn, last_fn, sp_params, hp, mb, (lab, msk),
+                    "pipe", uniform_stages=uniform)
             gl = _reduce_partials(gl, hp_specs)
             gs = _reduce_partials(gs, sp_specs)
             if tp_axis is not None:

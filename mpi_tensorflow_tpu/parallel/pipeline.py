@@ -138,6 +138,139 @@ def schedule_table(n_stages: int, num_microbatches: int) -> list:
     return table
 
 
+def interleaved_ring_depth(n_stages: int, num_microbatches: int) -> int:
+    """Per-chunk ring-buffer depth for the interleaved schedule: 2P
+    slots reach the Megatron-ideal bubble (P-deep rings throttle the
+    warmup back to the plain-1F1B bubble); M slots suffice when the
+    stream is shorter than that."""
+    return max(1, min(2 * n_stages, num_microbatches))
+
+
+def interleaved_table(n_stages: int, v: int, num_microbatches: int) -> list:
+    """Interleaved-1F1B schedule: ``v`` virtual stage chunks per device.
+
+    Chunk ``k`` (of ``V = v * n_stages``) lives on device ``k % P`` with
+    local index ``j = k // P``; every forward message rides the +1 ring
+    hop, every backward the -1 hop — same neighbor topology as plain
+    1F1B, just more chunks.  Built by dependency-driven greedy list
+    scheduling (backward-first, then earliest (mb, chunk)), honoring:
+
+    - message latency 1 tick (consume at >= produce + 1);
+    - one op per device per tick;
+    - Q-slot ring buffers per chunk for the stash and the in-flight
+      messages: F(k, i) needs B(k, i-Q) done (stash slot ``i % Q`` free)
+      and F(k+1, i-Q) done (the consumer's input slot free); mirrored
+      for backward cotangents.
+
+    Returns ``table[t][d] = ("F"|"B", chunk_local_j, mb_index) | None``.
+    Achieves the Megatron-ideal schedule length ``2vM + 2(P-1)`` ticks —
+    bubble ``(P-1)/(vM+P-1)``, ~v-fold below plain 1F1B (pinned by
+    tests).  The price is the deeper ring: ``Q = min(2P, M)`` slots per
+    chunk (``interleaved_ring_depth``) instead of plain 1F1B's P —
+    Megatron's warmup keeps up to ``2(P-1) + (v-1)P + 1`` chunk-ops in
+    flight per device, more than P-deep rings can hold (a P-deep ring
+    caps the schedule at the PLAIN bubble; measured while building
+    this) — and v x the ring messages.
+    """
+    P_, M, V = n_stages, num_microbatches, v * n_stages
+    Q = interleaved_ring_depth(n_stages, num_microbatches)
+    tick_f: dict = {}
+    tick_b: dict = {}
+
+    def done_before(d_, key, t):
+        """op done strictly before tick t (message latency)."""
+        return key in d_ and d_[key] < t
+
+    def done_by(d_, key, t):
+        """op done at or before tick t (slot freed; same-tick is safe —
+        reads happen during the owner's tick, overwrites at a later
+        one, and two ops never share a device-tick)."""
+        return key in d_ and d_[key] <= t
+
+    def b_ready(k, i, t):
+        if (k, i) in tick_b or not done_before(tick_f, (k, i), t):
+            return False
+        if k < V - 1 and not done_before(tick_b, (k + 1, i), t):
+            return False
+        # this B's cotangent message lands in chunk k-1's ring slot
+        # (i % Q): the previous occupant must have been consumed
+        if k > 0 and i >= Q and \
+                not done_by(tick_b, (k - 1, i - Q), t):
+            return False
+        return True
+
+    def f_ready(k, i, t):
+        if (k, i) in tick_f:
+            return False
+        if k > 0 and not done_before(tick_f, (k - 1, i), t):
+            return False
+        # stash ring slot (i % Q) free: B of the slot's prior tenant done
+        if i >= Q and not done_by(tick_b, (k, i - Q), t):
+            return False
+        # this F's output message lands in chunk k+1's ring slot (i % Q):
+        # its previous occupant must have been consumed
+        if k < V - 1 and i >= Q and \
+                not done_by(tick_f, (k + 1, i - Q), t):
+            return False
+        return True
+
+    # Megatron-style fixed op order per device: microbatches advance in
+    # GROUPS of P per chunk (breadth-first over the group, then the next
+    # chunk) — depth-first (push one mb through all chunks) stalls on the
+    # cross-device round-trip and yields a WORSE bubble than plain 1F1B.
+    # B order mirrors F with chunks reversed (B(k) depends on B(k+1)).
+    def f_order(d):
+        for g0 in range(0, M, P_):
+            group = range(g0, min(g0 + P_, M))
+            for j in range(v):
+                for i in group:
+                    yield (j * P_ + d, i)
+
+    def b_order(d):
+        for g0 in range(0, M, P_):
+            group = range(g0, min(g0 + P_, M))
+            for j in reversed(range(v)):
+                for i in group:
+                    yield (j * P_ + d, i)
+
+    f_seq = [list(f_order(d)) for d in range(P_)]
+    b_seq = [list(b_order(d)) for d in range(P_)]
+    f_ptr = [0] * P_
+    b_ptr = [0] * P_
+    # Megatron's warmup depth: 2(P-d-1) + (v-1)P forward chunk-ops before
+    # the first backward; steady state then holds in-flight constant
+    # (strict one-F-one-B), cooldown drains.  Encoded as a preference on
+    # in-flight count, work-conserving (falls back to the other op kind
+    # rather than idling when the preferred one is not ready).
+    target = [min(2 * (P_ - d - 1) + (v - 1) * P_ + 1, v * M)
+              for d in range(P_)]
+    table: list = []
+    t = 0
+    while len(tick_b) < V * M:
+        row: list = [None] * P_
+        for d in range(P_):
+            f_ok = (f_ptr[d] < len(f_seq[d])
+                    and f_ready(*f_seq[d][f_ptr[d]], t))
+            b_ok = (b_ptr[d] < len(b_seq[d])
+                    and b_ready(*b_seq[d][b_ptr[d]], t))
+            in_flight = f_ptr[d] - b_ptr[d]
+            pick_b = b_ok and (in_flight >= target[d] or not f_ok)
+            if pick_b:
+                k, i = b_seq[d][b_ptr[d]]
+                b_ptr[d] += 1
+                row[d] = ("B", k // P_, i)
+                tick_b[(k, i)] = t
+            elif f_ok:
+                k, i = f_seq[d][f_ptr[d]]
+                f_ptr[d] += 1
+                row[d] = ("F", k // P_, i)
+                tick_f[(k, i)] = t
+        table.append(row)
+        t += 1
+        assert t <= 8 * V * (M + P_), "interleaved scheduler wedged"
+    return table
+
+
 def schedule_cost(n_stages: int, num_microbatches: int,
                   uniform_stages: bool) -> dict:
     """Tick-level stage-body accounting for one ``pipeline_1f1b`` pass —
@@ -359,6 +492,228 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
     (_, _, _, gs, gl, loss, dx_out), _ = lax.scan(
         tick_fn, init, jnp.arange(ticks))
     # loss/gl/dx_out live on one stage each (zeros elsewhere): sum the ring
+    loss = lax.psum(loss, axis)
+    gl = jax.tree.map(lambda x: lax.psum(x, axis), gl)
+    dx_out = lax.psum(dx_out, axis)
+    return loss, gs, gl, dx_out
+
+
+def pipeline_1f1b_interleaved(stage_fn: Callable, last_fn: Callable,
+                              chunk_params: Any, last_params: Any,
+                              microbatches, mb_aux: Any,
+                              axis: str = "pipe", *, v: int,
+                              n_stages: int,
+                              uniform_stages: bool = True):
+    """Interleaved 1F1B: ``v`` virtual stage chunks per device.
+
+    Same contract as ``pipeline_1f1b`` except ``chunk_params`` carries a
+    leading ``(v, ...)`` axis — this device's chunks, where local chunk
+    ``j`` is GLOBAL chunk ``k = j * P + device`` (chunks ascend round-
+    robin so every hop is the +1 ring neighbor) — and ``stage_fn(cp, x,
+    mb_idx, chunk_k)`` receives the global chunk index for layer-offset
+    bookkeeping (dropout fold-ins).
+
+    Executes the static ``interleaved_table`` schedule inside one
+    ``lax.scan``: per tick each device runs its scheduled op (F body, or
+    B replay+vjp, or idle), reads/writes Q-slot ring buffers
+    (``interleaved_ring_depth``) for the stash and the in-flight
+    messages, and exchanges one fwd (+1) and one bwd (-1) ppermute.
+    Bubble = (P-1)/(vM+P-1), ~v-fold below plain 1F1B; activation
+    memory is 3*v*Q microbatch slots (stash + two message rings) vs
+    plain's ~P — the classic interleaving trade plus this executor's
+    separate-buffer simplicity.
+
+    ``uniform_stages`` as in ``pipeline_1f1b``: True runs both bodies
+    every tick and masks (required for collectives inside stages /
+    head); False slot-gates with ``lax.cond`` (collective-free only).
+
+    Returns ``(loss, d_chunk_params, d_last_params, d_microbatches)``.
+    """
+    import numpy as np
+
+    P_ = n_stages
+    s_idx = lax.axis_index(axis)
+    M = microbatches.shape[0]
+    V = v * P_
+    Q = interleaved_ring_depth(P_, M)
+    x_shape = microbatches.shape[1:]
+    f32 = jnp.float32
+
+    # ---- bake the static schedule as per-(tick, device) index tables
+    table = interleaved_table(P_, v, M)
+    T = len(table)
+    kind = np.zeros((T, P_), np.int32)          # 0 idle / 1 F / 2 B
+    jj = np.zeros((T, P_), np.int32)
+    ii = np.zeros((T, P_), np.int32)
+    for t, row in enumerate(table):
+        for d, op in enumerate(row):
+            if op is None:
+                continue
+            kind[t, d] = 1 if op[0] == "F" else 2
+            jj[t, d] = op[1]
+            ii[t, d] = op[2]
+    # arrival routing: a message in the carry at tick t was produced at
+    # t-1.  fwd from device d-1 (k -> k+1), bwd from device d+1 (k -> k-1).
+    fs_on = np.zeros((T, P_), bool)
+    fs_j = np.zeros((T, P_), np.int32)
+    fs_slot = np.zeros((T, P_), np.int32)
+    bs_on = np.zeros((T, P_), bool)
+    bs_j = np.zeros((T, P_), np.int32)
+    bs_slot = np.zeros((T, P_), np.int32)
+    for t in range(1, T):
+        for d in range(P_):
+            src = table[t - 1][(d - 1) % P_]
+            if src is not None and src[0] == "F":
+                k = src[1] * P_ + (d - 1) % P_
+                if k < V - 1:
+                    fs_on[t, d] = True
+                    fs_j[t, d] = (k + 1) // P_
+                    fs_slot[t, d] = src[2] % Q
+            src = table[t - 1][(d + 1) % P_]
+            if src is not None and src[0] == "B":
+                k = src[1] * P_ + (d + 1) % P_
+                if k > 0:
+                    bs_on[t, d] = True
+                    bs_j[t, d] = (k - 1) // P_
+                    bs_slot[t, d] = src[2] % Q
+    as_const = jnp.asarray
+    KIND, JJ, II = as_const(kind), as_const(jj), as_const(ii)
+    FS_ON, FS_J, FS_SLOT = as_const(fs_on), as_const(fs_j), as_const(fs_slot)
+    BS_ON, BS_J, BS_SLOT = as_const(bs_on), as_const(bs_j), as_const(bs_slot)
+
+    sel_chunk = lambda tree, j: jax.tree.map(
+        lambda a: lax.dynamic_index_in_dim(a, j, 0, keepdims=False), tree)
+
+    def tick_fn(carry, t):
+        (fwd_msg, bwd_msg, fwd_buf, bwd_buf, stash,
+         gs, gl, loss, dx_out) = carry
+        knd = KIND[t, s_idx]
+        j = JJ[t, s_idx]
+        i = II[t, s_idx]
+        k_glob = j * P_ + s_idx
+        slot = i % Q
+
+        # ---- store arrivals (carry messages were produced last tick)
+        fwd_buf = lax.cond(
+            FS_ON[t, s_idx],
+            lambda b: b.at[FS_J[t, s_idx], FS_SLOT[t, s_idx]].set(fwd_msg),
+            lambda b: b, fwd_buf)
+        bwd_buf = lax.cond(
+            BS_ON[t, s_idx],
+            lambda b: b.at[BS_J[t, s_idx], BS_SLOT[t, s_idx]].set(bwd_msg),
+            lambda b: b, bwd_buf)
+
+        f_on = knd == 1
+        b_on = knd == 2
+        from_stream = (k_glob == 0) & f_on
+        x_in = jnp.where(from_stream,
+                         microbatches[jnp.clip(i, 0, M - 1)]
+                         .astype(fwd_buf.dtype),
+                         fwd_buf[j, slot])
+        cp_f = sel_chunk(chunk_params, j)
+        if uniform_stages:
+            y_all = stage_fn(cp_f, x_in, i, k_glob)
+            y = jnp.where(f_on, y_all, jnp.zeros(x_shape, y_all.dtype))
+        else:
+            y = lax.cond(
+                f_on,
+                lambda xx: stage_fn(cp_f, xx, i, k_glob),
+                lambda xx: jnp.zeros(x_shape, fwd_buf.dtype), x_in)
+        stash = lax.cond(
+            f_on,
+            lambda s: s.at[j, slot].set(x_in),
+            lambda s: s, stash)
+
+        def bwd_math(c):
+            bwd_buf, stash, gs, gl, loss, dx_out, gate = c
+            x = stash[j, slot]
+            cp_b = sel_chunk(chunk_params, j)
+            yb, vjp_fn = jax.vjp(
+                lambda cp, xx: stage_fn(cp, xx, i, k_glob), cp_b, x)
+
+            def head_math(yb):
+                aux_i = jax.tree.map(lambda a: a[jnp.clip(i, 0, M - 1)],
+                                     mb_aux)
+                li, last_vjp = jax.vjp(
+                    lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
+                dlp, dy = last_vjp(jnp.ones((), li.dtype))
+                return li, dlp, dy
+
+            is_last = k_glob == V - 1
+            if uniform_stages:
+                li, dlp, dy_head = head_math(yb)
+                on_last = gate & is_last
+                gl = jax.tree.map(
+                    lambda g, d: g + jnp.where(on_last, d,
+                                               jnp.zeros_like(d)),
+                    gl, dlp)
+                loss = loss + jnp.where(on_last, li, 0.0)
+                dy = jnp.where(is_last, dy_head,
+                               bwd_buf[j, slot].astype(dy_head.dtype))
+            else:
+                def last_stage(args):
+                    yb, gl, loss = args
+                    li, dlp, dy = head_math(yb)
+                    gl = jax.tree.map(
+                        lambda g, d: g + jnp.where(gate, d,
+                                                   jnp.zeros_like(d)),
+                        gl, dlp)
+                    return dy, gl, loss + jnp.where(gate, li, 0.0)
+
+                def mid_stage(args):
+                    yb, gl, loss = args
+                    return bwd_buf[j, slot].astype(yb.dtype), gl, loss
+
+                dy, gl, loss = lax.cond(is_last, last_stage, mid_stage,
+                                        (yb, gl, loss))
+            dcp, dx = vjp_fn(dy)
+            gs = jax.tree.map(
+                lambda g, d: g.at[j].add(
+                    jnp.where(gate, d, jnp.zeros_like(d))), gs, dcp)
+            dx_out = lax.cond(
+                gate & (k_glob == 0),
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, dx.astype(f32), jnp.clip(i, 0, M - 1), 0),
+                lambda o: o, dx_out)
+            dx_send = jnp.where(gate, dx.astype(fwd_msg.dtype),
+                                jnp.zeros(x_shape, fwd_msg.dtype))
+            return dx_send, stash, gs, gl, loss, dx_out
+
+        if uniform_stages:
+            dx_send, stash, gs, gl, loss, dx_out = bwd_math(
+                (bwd_buf, stash, gs, gl, loss, dx_out, b_on))
+        else:
+            dx_send, stash, gs, gl, loss, dx_out = lax.cond(
+                b_on,
+                lambda c: bwd_math(c),
+                lambda c: (jnp.zeros(x_shape, fwd_msg.dtype),) + c[1:6],
+                (bwd_buf, stash, gs, gl, loss, dx_out, jnp.bool_(True)))
+
+        perm_f = [(q, (q + 1) % P_) for q in range(P_)]
+        perm_b = [(q, (q - 1) % P_) for q in range(P_)]
+        fwd_msg = lax.ppermute(
+            jnp.where(f_on, y, jnp.zeros(x_shape, y.dtype)), axis, perm_f)
+        bwd_msg = lax.ppermute(dx_send, axis, perm_b)
+        return (fwd_msg, bwd_msg, fwd_buf, bwd_buf, stash,
+                gs, gl, loss, dx_out), None
+
+    zero_like_local = lambda tree: jax.tree.map(
+        lambda x: jnp.zeros(jnp.shape(x), f32), tree)
+    seed = jnp.sum(microbatches[:1]) * 0
+    mdt = microbatches.dtype
+    init = (
+        jnp.zeros(x_shape, mdt) + seed,
+        jnp.zeros(x_shape, mdt) + seed,
+        jnp.zeros((v, Q) + x_shape, mdt) + seed,
+        jnp.zeros((v, Q) + x_shape, mdt) + seed,
+        jnp.zeros((v, Q) + x_shape, mdt) + seed,
+        zero_like_local(chunk_params),
+        zero_like_local(last_params),
+        jnp.zeros((), f32),
+        jnp.zeros((M,) + x_shape, f32) + seed,
+    )
+    (_, _, _, _, _, gs, gl, loss, dx_out), _ = lax.scan(
+        tick_fn, init, jnp.arange(T))
     loss = lax.psum(loss, axis)
     gl = jax.tree.map(lambda x: lax.psum(x, axis), gl)
     dx_out = lax.psum(dx_out, axis)

@@ -35,6 +35,7 @@ DEFAULT_RULES: dict[str, Optional[str]] = {
     "capacity": None,    # per-expert token buffer dim (models/moe.py)
     "stage": "pipe",     # pipeline-stage stacks (parallel/pipeline.py)
     "layer": None,       # within-stage layer dim (models/bert_pipeline.py)
+    "vchunk": None,      # interleaved virtual-chunk dim (1f1b_interleaved)
 }
 
 

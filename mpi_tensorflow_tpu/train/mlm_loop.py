@@ -74,7 +74,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
                   "traces are not interchangeable between the two",
                   flush=True)
             model = moe.PipelinedMoeBertMlm(
-                bert_cfg, mesh=mesh, schedule=config.pp_schedule)
+                bert_cfg, mesh=mesh, schedule=config.pp_schedule,
+                virtual_stages=config.virtual_stages)
         else:
             model = moe.MoeBertMlm(bert_cfg, mesh=mesh)
     elif config.model == "gpt_base":
@@ -86,7 +87,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
             # ce_positions directly, and packing is an MLM concept
             model = gpt.PipelinedCausalLm(
                 dataclasses.replace(bert_cfg, ce_positions="all"),
-                mesh=mesh, schedule=config.pp_schedule)
+                mesh=mesh, schedule=config.pp_schedule,
+                virtual_stages=config.virtual_stages)
         else:
             model = gpt.CausalLm(bert_cfg, mesh=mesh)
     elif config.model == "encdec_t5":
@@ -104,7 +106,8 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         from mpi_tensorflow_tpu.models import bert_pipeline
 
         model = bert_pipeline.PipelinedBertMlm(
-            bert_cfg, mesh=mesh, schedule=config.pp_schedule)
+            bert_cfg, mesh=mesh, schedule=config.pp_schedule,
+            virtual_stages=config.virtual_stages)
     else:
         model = bert.BertMlm(bert_cfg, mesh=mesh)
 
