@@ -814,10 +814,12 @@ def _iter_measure_records():
     preceding watcher-line timestamp (the only dating round-3 rows
     have).  Every consumer (stale fallback, hostio demand lookup) must
     go through here so a log-format change is fixed once."""
-    if not os.path.exists(MEASURE_LOG):
-        return
     watch_ts = None
-    with open(MEASURE_LOG) as f:
+    try:
+        f = open(MEASURE_LOG)
+    except OSError:
+        return      # absent or unreadable: consumers use their defaults
+    with f:
         for idx, line in enumerate(f):
             line = line.strip()
             if not line:

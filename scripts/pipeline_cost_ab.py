@@ -36,33 +36,66 @@ jax.config.update("jax_platforms", "cpu")
 from mpi_tensorflow_tpu.parallel import pipeline  # noqa: E402
 
 
-def build(uniform: bool, Pst: int, M: int, mb: int, d: int):
+def build(uniform: bool, Pst: int, M: int, mb: int, d: int, v: int = 1,
+          total_layers: int | None = None):
+    """Equal-total-work arms: ``total_layers`` (d,d) matmuls split into
+    P stages of L/P each (v=1, plain 1F1B) or v*P chunks of L/(vP) each
+    (v>1, interleaved) — wall-clock differences are schedule, not
+    model."""
     mesh = jax.make_mesh((Pst,), ("pipe",), devices=jax.devices()[:Pst])
     rng = np.random.default_rng(0)
-    W = jnp.asarray(rng.normal(size=(Pst, d, d)).astype(np.float32) * .2)
+    L = total_layers if total_layers is not None else 2 * Pst
+    V = v * Pst
+    assert L % V == 0
+    W = jnp.asarray(rng.normal(size=(L, d, d)).astype(np.float32) * .2)
     Wl = jnp.asarray(rng.normal(size=(d,)).astype(np.float32))
     x = jnp.asarray(rng.normal(size=(M, mb, d)).astype(np.float32))
     tgt = jnp.asarray(rng.normal(size=(M, mb, d)).astype(np.float32))
 
-    def stage_fn(w, h, mi):
-        return jnp.tanh(h @ w)
-
     def last_fn(wl, y, aux):
         return jnp.sum((y * wl - aux) ** 2) / (M * mb)
 
-    def run(W, Wl, x, tgt):
+    def body(ws, h):
+        for q in range(ws.shape[0]):          # L/V matmuls per chunk
+            h = jnp.tanh(h @ ws[q])
+        return h
+
+    Lc = L // V
+    if v == 1:
+        def stage_fn(w, h, mi):
+            return body(w, h)
+
         def inner(Wloc, Wl, x, tgt):
             loss, gs, gl, dx = pipeline.pipeline_1f1b(
                 stage_fn, last_fn, Wloc[0], Wl, x, tgt, "pipe",
                 uniform_stages=uniform)
             return loss, gs[None], gl, dx
+
+        Wstack = W.reshape(Pst, Lc, d, d)
+    else:
+        def chunk_fn(w, h, mi, kg):
+            return body(w, h)
+
+        def inner(Wloc, Wl, x, tgt):
+            loss, gs, gl, dx = pipeline.pipeline_1f1b_interleaved(
+                chunk_fn, last_fn, Wloc[0], Wl, x, tgt, "pipe",
+                v=v, n_stages=Pst, uniform_stages=uniform)
+            return loss, gs[None], gl, dx
+
+        # device-major chunk stack: stacked[dev, j] = chunk j*P + dev
+        ch = W.reshape(V, Lc, d, d)
+        Wstack = jnp.stack([jnp.stack([ch[j * Pst + dev]
+                                       for j in range(v)])
+                            for dev in range(Pst)])   # (P, v, Lc, d, d)
+
+    def run(Wstack, Wl, x, tgt):
         return jax.shard_map(
             inner, mesh=mesh, in_specs=(P("pipe"), P(), P(), P()),
             out_specs=(P(), P("pipe"), P(), P()),
-            check_vma=False)(W, Wl, x, tgt)
+            check_vma=False)(Wstack, Wl, x, tgt)
 
     fn = jax.jit(run)
-    args = (W, Wl, x, tgt)
+    args = (Wstack, Wl, x, tgt)
     jax.block_until_ready(fn(*args))      # compile + warm
     return fn, args
 
@@ -87,6 +120,13 @@ def main() -> None:
         print(f"uniform={uniform}: {sec*1e3:.2f} ms/pass "
               f"(predicted body-equiv ratio {pred['overhead_ratio']:.2f})",
               flush=True)
+    v = 2
+    il = {}
+    for uniform in (False, True):
+        fn, args = build(uniform, Pst, M, mb, d, v=v)
+        il[uniform] = timed(fn, args, iters)
+        print(f"interleaved v={v} uniform={uniform}: "
+              f"{il[uniform]*1e3:.2f} ms/pass", flush=True)
     ratio = rows[1][1] / rows[0][1]
     pred_ratio = rows[1][2]["overhead_ratio"] / rows[0][2]["overhead_ratio"]
     doc = f"""# 1F1B schedule cost: gated vs uniform stages
@@ -101,8 +141,10 @@ silently wrong seq-sharded forward).  The price, from
 
 | schedule path | body-equiv per device (predicted) | measured ms/pass |
 |---|---|---|
-| gated (collective-free meshes) | {rows[0][2]['total_body_equiv']} (useful work only) | {rows[0][1]*1e3:.2f} |
-| uniform (collectives in stages) | {rows[1][2]['total_body_equiv']} ({rows[1][2]['overhead_ratio']:.2f}x useful) | {rows[1][1]*1e3:.2f} |
+| 1f1b gated (collective-free meshes) | {rows[0][2]['total_body_equiv']} (useful work only) | {rows[0][1]*1e3:.2f} |
+| 1f1b uniform (collectives in stages) | {rows[1][2]['total_body_equiv']} ({rows[1][2]['overhead_ratio']:.2f}x useful) | {rows[1][1]*1e3:.2f} |
+| 1f1b_interleaved v={v} gated | same useful work, bubble {Pst-1}/{v*M+Pst-1} vs {Pst-1}/{M+Pst-1} | {il[False]*1e3:.2f} |
+| 1f1b_interleaved v={v} uniform | ~2x + bubble/v | {il[True]*1e3:.2f} |
 
 Measured uniform/gated wall ratio: **{ratio:.2f}x** (predicted
 body-equivalent ratio {pred_ratio:.2f}x; wall clock sits below the pure
@@ -118,7 +160,17 @@ Consequences:
   useful stage compute.  GPipe's scan pays `(M+P-1)/M`x on the forward
   (its backward is autodiff of the same scan, so the ratio matches);
   1F1B's advantage there is memory (O(P) vs O(M) stash), not compute.
-- Raising M amortizes both schedules' bubbles; the uniform overhead
+- `schedule="1f1b_interleaved"` (v virtual chunks/device) shrinks the
+  BUBBLE to (P-1)/(vM+P-1).  On the uniform path each wasted tick costs
+  1/v the body, so the fixed ~2x floor converges from above as
+  2 + 2(P-1)/(vM) — consistently measured faster than plain-uniform
+  above.  The gated rows differ only by the bubble (~12% ideal at these
+  shapes) and sit within run-to-run noise of each other on this
+  oversubscribed 1-core box; on real hardware the bubble is the
+  difference.  The price: 2P-deep per-chunk rings (~3*v*min(2P,M)
+  stashed microbatch activations vs plain's ~P) and v x the ppermute
+  messages.
+- Raising M amortizes every schedule's bubble; the uniform overhead
   falls toward 2x and the bubble toward 0.
 
 (Recorded by scripts/pipeline_cost_ab.py; re-run after schedule changes.)
