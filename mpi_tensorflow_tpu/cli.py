@@ -90,6 +90,14 @@ def build_parser() -> argparse.ArgumentParser:
                         "checkpoint after transient infrastructure "
                         "failures (train/elastic.py; pair with "
                         "--checkpoint-dir)")
+    p.add_argument("--param-sharding",
+                   choices=["replicated", "fsdp", "zero1"],
+                   default=d.param_sharding,
+                   help="transformer-family state layout: replicated "
+                        "(default), fsdp (params+moments sharded over "
+                        "'data', ZeRO-3-style), or zero1 (optimizer "
+                        "moments sharded, params keep their layout — "
+                        "composes with pipe meshes)")
     p.add_argument("--prefetch", choices=["auto", "native", "thread", "off"],
                    default=d.prefetch,
                    help="background window assembly for the fused loop "
@@ -153,6 +161,7 @@ def config_from_args(args) -> Config:
         optimizer=args.optimizer, grad_accum=args.grad_accum,
         pp_schedule=args.pp_schedule,
         virtual_stages=args.virtual_stages,
+        param_sharding=args.param_sharding,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
@@ -183,6 +192,13 @@ def main(argv=None) -> int:
             f"--optimizer {config.optimizer} applies to the transformer "
             f"families; the image families train with the reference's "
             f"momentum SGD (mpipy.py:65) and would silently ignore it")
+    if config.param_sharding != "replicated" and config.model not in (
+            "bert_base", "moe_bert", "gpt_base", "encdec_t5"):
+        raise SystemExit(
+            f"--param-sharding {config.param_sharding} applies to the "
+            f"transformer families (GSPMD step); the image loop keeps "
+            f"the reference's replicated layout and would silently "
+            f"ignore it")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

@@ -68,6 +68,12 @@ class Config:
                                   # runs: real-vocab training exercises the
                                   # packed/chunked MLM head at flagship
                                   # vocab size; None = byte-level (261)
+    param_sharding: str = "replicated"  # transformer-family state layout:
+                                  # "replicated" (pure DP/TP/PP rules),
+                                  # "fsdp" (params+moments data-sharded,
+                                  # ZeRO-3-style via GSPMD), or "zero1"
+                                  # (params keep their layout, moments
+                                  # data-sharded — composes with PP)
     prefetch: str = "auto"        # window-assembly prefetch for the fused
                                   # loop: "auto" (native C++ worker when
                                   # built, else Python thread), "native",

@@ -309,6 +309,61 @@ def schedule_cost(n_stages: int, num_microbatches: int,
             "bubble_fraction": (p - 1) / (m + p - 1)}
 
 
+def _bwd_core(stage_call: Callable, stage_p: Any, last_fn: Callable,
+              last_params: Any, aux_i: Any, x, incoming_dy, is_last,
+              gate, uniform: bool):
+    """The backward op shared by both 1F1B executors: replay the stage
+    from its stashed input, seed the output cotangent from the head
+    (last stage/chunk) or the incoming message, and differentiate.
+
+    ``stage_call(params, x) -> y`` is the stage body closed over
+    everything but its differentiable inputs.  Under ``uniform`` the
+    head math runs unconditionally and is masked by ``gate & is_last``
+    (collectives may not sit under the rank-varying cond — see
+    ``pipeline_1f1b``); the gated path keeps the ``lax.cond`` and is
+    valid for collective-free stages/heads only.
+
+    Returns ``(dsp, dx, dlp_add, li_add)``: raw stage-param and input
+    cotangents (caller masks/accumulates — the two executors index
+    their grads differently) plus ready-masked head-grad and loss
+    addends."""
+    yb, vjp_fn = jax.vjp(stage_call, stage_p, x)
+
+    def head_math(yb):
+        li, last_vjp = jax.vjp(
+            lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
+        dlp, dy = last_vjp(jnp.ones((), li.dtype))
+        return li, dlp, dy
+
+    if uniform:
+        li, dlp, dy_head = head_math(yb)
+        on_last = gate & is_last
+        dlp_add = jax.tree.map(
+            lambda d: jnp.where(on_last, d, jnp.zeros_like(d)), dlp)
+        li_add = jnp.where(on_last, li, 0.0).astype(jnp.float32)
+        dy = jnp.where(is_last, dy_head,
+                       incoming_dy.astype(dy_head.dtype))
+    else:
+        def last_stage(yb):
+            li, dlp, dy = head_math(yb)
+            # f32 to match mid_stage's zero (cond branch types must
+            # agree even for a low-precision last_fn)
+            return (dy,
+                    jax.tree.map(
+                        lambda d: jnp.where(gate, d, jnp.zeros_like(d)),
+                        dlp),
+                    jnp.where(gate, li, 0.0).astype(jnp.float32))
+
+        def mid_stage(yb):
+            return (incoming_dy.astype(yb.dtype),
+                    jax.tree.map(jnp.zeros_like, last_params),
+                    jnp.zeros((), jnp.float32))
+
+        dy, dlp_add, li_add = lax.cond(is_last, last_stage, mid_stage, yb)
+    dsp, dx = vjp_fn(dy)
+    return dsp, dx, dlp_add, li_add
+
+
 def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
                   last_params: Any, microbatches, mb_aux: Any,
                   axis: str = "pipe", *, uniform_stages: bool = True):
@@ -393,59 +448,23 @@ def pipeline_1f1b(stage_fn: Callable, last_fn: Callable, stage_params: Any,
         b_on = (b_num >= 0) & (b_num % 2 == 0) & (b_num // 2 < m)
 
         def bwd_math(c):
-            """The shared backward body: stage replay + head-or-message
-            cotangent + vjp.  Accumulations masked by ``gate`` (constant
+            """The shared backward body (``_bwd_core``): stage replay +
+            head-or-message cotangent + vjp.  The head math runs
+            unconditionally on the uniform path — ``last_fn`` may carry
+            collectives over OTHER mesh axes (vocab-parallel CE's psum
+            over 'model') and the ``s_idx == n-1`` predicate varies
+            across pipe ranks, the same unsound pattern the uniform path
+            exists to avoid.  Accumulations masked by ``gate`` (constant
             True on the gated path — the cond already gates)."""
             bwd_msg, stash, gs, gl, loss, dx_out, gate = c
             x = stash[i_b % n]
-            yb, vjp_fn = jax.vjp(
-                lambda sp, xx: stage_fn(sp, xx, i_b), stage_params, x)
-
-            def head_math(yb):
-                aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
-                li, last_vjp = jax.vjp(
-                    lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
-                dlp, dy = last_vjp(jnp.ones((), li.dtype))
-                return li, dlp, dy
-
-            if uniform_stages:
-                # ``last_fn`` may itself contain collectives over OTHER
-                # mesh axes (vocab-parallel CE's psum/all_gather over
-                # 'model').  The ``s_idx == n-1`` predicate varies across
-                # pipe ranks, so putting those collectives under a cond is
-                # the same unsound pattern the uniform path exists to
-                # avoid (each 'model' psum group is branch-uniform today,
-                # but that is fragile across XLA versions).  Run the head
-                # math unconditionally and mask by rank+slot instead.
-                li, dlp, dy_head = head_math(yb)
-                on_last = gate & (s_idx == n - 1)
-                gl = jax.tree.map(
-                    lambda g, d: g + jnp.where(on_last, d,
-                                               jnp.zeros_like(d)),
-                    gl, dlp)
-                loss = loss + jnp.where(on_last, li, 0.0)
-                dy = jnp.where(s_idx == n - 1, dy_head,
-                               bwd_msg.astype(dy_head.dtype))
-            else:
-                def last_stage(args):
-                    # gated path: stages are collective-free by contract,
-                    # and the head's TP psums (if any) would span
-                    # same-pipe-rank devices that share this branch
-                    yb, gl, loss = args
-                    li, dlp, dy = head_math(yb)
-                    gl = jax.tree.map(
-                        lambda g, d: g + jnp.where(gate, d,
-                                                   jnp.zeros_like(d)),
-                        gl, dlp)
-                    return dy, gl, loss + jnp.where(gate, li, 0.0)
-
-                def mid_stage(args):
-                    yb, gl, loss = args
-                    return bwd_msg.astype(yb.dtype), gl, loss
-
-                dy, gl, loss = lax.cond(s_idx == n - 1, last_stage,
-                                        mid_stage, (yb, gl, loss))
-            dsp, dx = vjp_fn(dy)
+            aux_i = jax.tree.map(lambda a: a[i_b], mb_aux)
+            dsp, dx, dlp_add, li_add = _bwd_core(
+                lambda sp, xx: stage_fn(sp, xx, i_b), stage_params,
+                last_fn, last_params, aux_i, x, bwd_msg,
+                s_idx == n - 1, gate, uniform_stages)
+            gl = jax.tree.map(jnp.add, gl, dlp_add)
+            loss = loss + li_add
             gs = jax.tree.map(
                 lambda g, d: g + jnp.where(gate, d, jnp.zeros_like(d)),
                 gs, dsp)
@@ -628,45 +647,14 @@ def pipeline_1f1b_interleaved(stage_fn: Callable, last_fn: Callable,
             bwd_buf, stash, gs, gl, loss, dx_out, gate = c
             x = stash[j, slot]
             cp_b = sel_chunk(chunk_params, j)
-            yb, vjp_fn = jax.vjp(
-                lambda cp, xx: stage_fn(cp, xx, i, k_glob), cp_b, x)
-
-            def head_math(yb):
-                aux_i = jax.tree.map(lambda a: a[jnp.clip(i, 0, M - 1)],
-                                     mb_aux)
-                li, last_vjp = jax.vjp(
-                    lambda lp, yy: last_fn(lp, yy, aux_i), last_params, yb)
-                dlp, dy = last_vjp(jnp.ones((), li.dtype))
-                return li, dlp, dy
-
-            is_last = k_glob == V - 1
-            if uniform_stages:
-                li, dlp, dy_head = head_math(yb)
-                on_last = gate & is_last
-                gl = jax.tree.map(
-                    lambda g, d: g + jnp.where(on_last, d,
-                                               jnp.zeros_like(d)),
-                    gl, dlp)
-                loss = loss + jnp.where(on_last, li, 0.0)
-                dy = jnp.where(is_last, dy_head,
-                               bwd_buf[j, slot].astype(dy_head.dtype))
-            else:
-                def last_stage(args):
-                    yb, gl, loss = args
-                    li, dlp, dy = head_math(yb)
-                    gl = jax.tree.map(
-                        lambda g, d: g + jnp.where(gate, d,
-                                                   jnp.zeros_like(d)),
-                        gl, dlp)
-                    return dy, gl, loss + jnp.where(gate, li, 0.0)
-
-                def mid_stage(args):
-                    yb, gl, loss = args
-                    return bwd_buf[j, slot].astype(yb.dtype), gl, loss
-
-                dy, gl, loss = lax.cond(is_last, last_stage, mid_stage,
-                                        (yb, gl, loss))
-            dcp, dx = vjp_fn(dy)
+            aux_i = jax.tree.map(lambda a: a[jnp.clip(i, 0, M - 1)],
+                                 mb_aux)
+            dcp, dx, dlp_add, li_add = _bwd_core(
+                lambda cp, xx: stage_fn(cp, xx, i, k_glob), cp_b,
+                last_fn, last_params, aux_i, x, bwd_buf[j, slot],
+                k_glob == V - 1, gate, uniform_stages)
+            gl = jax.tree.map(jnp.add, gl, dlp_add)
+            loss = loss + li_add
             gs = jax.tree.map(
                 lambda g, d: g.at[j].add(
                     jnp.where(gate, d, jnp.zeros_like(d))), gs, dcp)

@@ -170,10 +170,26 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
     tx = opt_lib.transformer_tx(
         learning_rate, num_steps, schedule=lr_schedule,
         optimizer=getattr(config, "optimizer", "adamw"))
-    state = gspmd.init_gspmd_state(model, tx, jax.random.key(config.seed),
-                                   mesh)
+    ps = getattr(config, "param_sharding", "replicated")
+    key0 = jax.random.key(config.seed)
+    if ps == "fsdp":
+        if mesh.shape.get("pipe", 1) > 1:
+            # FSDP re-shards the stage params themselves over 'data',
+            # breaking the pipeline schedules' shard_map layout contract
+            raise ValueError(
+                "--param-sharding fsdp does not compose with a 'pipe' "
+                "mesh axis (stage params must keep the pipeline layout);"
+                " use --param-sharding zero1, which shards only the "
+                "optimizer moments")
+        state = gspmd.init_fsdp_state(model, tx, key0, mesh)
+    elif ps == "zero1":
+        state = gspmd.init_zero1_state(model, tx, key0, mesh)
+    else:
+        state = gspmd.init_gspmd_state(model, tx, key0, mesh)
     train_step = gspmd.make_gspmd_train_step(
-        model, mesh, tx, grad_accum=getattr(config, "grad_accum", 1))
+        model, mesh, tx,
+        state_template=state if ps != "replicated" else None,
+        grad_accum=getattr(config, "grad_accum", 1))
     eval_step = gspmd.make_gspmd_eval_step(model, mesh)
 
     from mpi_tensorflow_tpu.train.ckpt_hooks import CheckpointHooks
