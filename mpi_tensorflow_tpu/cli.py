@@ -19,6 +19,8 @@ import argparse
 from mpi_tensorflow_tpu.config import Config
 
 
+TRANSFORMER_MODELS = ("bert_base", "moe_bert", "gpt_base", "encdec_t5")
+
 def build_parser() -> argparse.ArgumentParser:
     d = Config()
     p = argparse.ArgumentParser(
@@ -186,14 +188,12 @@ def main(argv=None) -> int:
             f"would silently ignore it")
     if config.vocab_file and not config.text_file:
         raise SystemExit("--vocab-file only applies with --text-file")
-    if config.optimizer != "adamw" and config.model not in (
-            "bert_base", "moe_bert", "gpt_base", "encdec_t5"):
+    if config.optimizer != "adamw" and config.model not in TRANSFORMER_MODELS:
         raise SystemExit(
             f"--optimizer {config.optimizer} applies to the transformer "
             f"families; the image families train with the reference's "
             f"momentum SGD (mpipy.py:65) and would silently ignore it")
-    if config.param_sharding != "replicated" and config.model not in (
-            "bert_base", "moe_bert", "gpt_base", "encdec_t5"):
+    if config.param_sharding != "replicated" and config.model not in TRANSFORMER_MODELS:
         raise SystemExit(
             f"--param-sharding {config.param_sharding} applies to the "
             f"transformer families (GSPMD step); the image loop keeps "

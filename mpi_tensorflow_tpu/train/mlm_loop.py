@@ -172,6 +172,14 @@ def train_mlm(config: Config, bert_cfg: Optional[bert.BertConfig] = None,
         optimizer=getattr(config, "optimizer", "adamw"))
     ps = getattr(config, "param_sharding", "replicated")
     key0 = jax.random.key(config.seed)
+    if ps != "replicated" and mesh.shape.get("data", 1) <= 1:
+        # augment_spec is a no-op without a >1 'data' axis: training
+        # would proceed fully replicated while the user believes the
+        # ZeRO sharding engaged
+        raise ValueError(
+            f"--param-sharding {ps} shards over the 'data' mesh axis, "
+            f"but this mesh has none (mesh {dict(mesh.shape)}); add "
+            f"data=N or drop the flag")
     if ps == "fsdp":
         if mesh.shape.get("pipe", 1) > 1:
             # FSDP re-shards the stage params themselves over 'data',

@@ -1298,3 +1298,89 @@ class TestInterleaved:
         l_gp, _ = gp.loss(gpp, None, batch, targets, train=True)
         l_il, _ = il.loss(ilp, None, batch, targets, train=True)
         np.testing.assert_allclose(float(l_il), float(l_gp), rtol=2e-5)
+
+
+class TestInterleavedSP:
+    """Interleaved 1F1B composes with sequence parallelism inside
+    chunks (ring attention over 'seq') and with the GPT family — the
+    same uniform-stages rationale as plain 1F1B."""
+
+    @pytest.fixture(scope="class")
+    def mesh_ps(self):
+        return meshlib.make_mesh({"pipe": 2, "seq": 2, "data": 2})
+
+    def test_interleaved_sp_matches_gpipe(self, mesh_ps):
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.0,
+                              ce_positions="all")
+        gp = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh_ps,
+                                            num_microbatches=2)
+        il = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh_ps,
+                                            num_microbatches=2,
+                                            schedule="1f1b_interleaved",
+                                            virtual_stages=2)
+        plain = bert.BertMlm(cfg)
+        params = plain.init(jax.random.key(0))
+        gpp = dict(params)
+        gpp["layers"] = bert_pipeline.stack_layers(params["layers"], 2)
+        gpp = sharding_rules.shard_tree(gpp, gp.logical_axes(), mesh_ps)
+        ilp = dict(params)
+        ilp["layers"] = bert_pipeline.stack_layers_interleaved(
+            params["layers"], 2, 2)
+        ilp = sharding_rules.shard_tree(ilp, il.logical_axes(), mesh_ps)
+        tokens, targets, mask = synthetic.mlm_batches(
+            8, seq_len=16, vocab_size=cfg.vocab_size, seed=0)
+        batch = {"tokens": tokens, "mask": mask}
+        l_gp, _ = gp.loss(gpp, None, batch, targets, train=True)
+        l_il, _ = il.loss(ilp, None, batch, targets, train=True)
+        np.testing.assert_allclose(float(l_il), float(l_gp), rtol=2e-5)
+
+    def test_gpt_interleaved_trains(self):
+        """The causal family inherits the schedule (PipelinedCausalLm
+        subclasses PipelinedBertMlm)."""
+        from mpi_tensorflow_tpu.models import gpt
+
+        mesh = meshlib.make_mesh({"pipe": 2, "data": 2},
+                                 devices=jax.devices()[:4])
+        cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.0,
+                              ce_positions="all")
+        model = gpt.PipelinedCausalLm(cfg, mesh=mesh, num_microbatches=2,
+                                      schedule="1f1b_interleaved",
+                                      virtual_stages=2)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_gspmd_state(model, tx, jax.random.key(0), mesh)
+        step = gspmd.make_gspmd_train_step(model, mesh, tx)
+        toks, tgts, mask = synthetic.mlm_batches(
+            8, seq_len=16, vocab_size=cfg.vocab_size, seed=0)
+        b = gspmd.shard_batch({"tokens": toks, "mask": mask}, mesh)
+        t = gspmd.shard_batch(tgts, mesh)
+        state, m = step(state, b, t, jax.random.key(1))
+        assert np.isfinite(float(m["loss"]))
+
+    def test_zero1_composes_with_interleaved(self):
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        mesh = meshlib.make_mesh({"pipe": 2, "data": 4})
+        cfg = bert.BertConfig(vocab_size=128, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.0)
+        model = bert_pipeline.PipelinedBertMlm(
+            cfg, mesh=mesh, num_microbatches=2,
+            schedule="1f1b_interleaved", virtual_stages=2)
+        tx = optax.adamw(1e-3)
+        state = gspmd.init_zero1_state(model, tx, jax.random.key(0), mesh,
+                                       min_size=512)
+        step = gspmd.make_gspmd_train_step(model, mesh, tx,
+                                           state_template=state)
+        toks, tgts, mask = synthetic.mlm_batches(
+            8, seq_len=16, vocab_size=cfg.vocab_size, seed=0)
+        b = gspmd.shard_batch({"tokens": toks, "mask": mask}, mesh)
+        t = gspmd.shard_batch(tgts, mesh)
+        before = jax.tree.map(lambda x: x.sharding, state)
+        state, m = step(state, b, t, jax.random.key(1))
+        assert np.isfinite(float(m["loss"]))
+        after = jax.tree.map(lambda x: x.sharding, state)
+        assert jax.tree.all(jax.tree.map(lambda a, b: a == b, before,
+                                         after))
