@@ -16,6 +16,7 @@ def test_dryrun_multichip_all_strategies(capsys):
     for marker in ("BERT DPxTPxSP ok", "Ulysses SP ok",
                    "data-parallel psum ok", "MoE DPxEP ok",
                    "FSDP/ZeRO ok", "pipeline PP ok", "pipeline 1F1B ok",
+                   "pipeline 1F1B-interleaved ok", "FSDP(ZeRO-1)xPP ok",
                    "pipeline PPxTP ok", "TP decode ok",
                    "enc-dec (cross-attention) ok",
                    "ViT data-parallel ok", "MoE-under-PP ok",

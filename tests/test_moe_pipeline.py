@@ -1384,3 +1384,37 @@ class TestInterleavedSP:
         after = jax.tree.map(lambda x: x.sharding, state)
         assert jax.tree.all(jax.tree.map(lambda a, b: a == b, before,
                                          after))
+
+    def test_moe_interleaved_matches_gpipe(self):
+        """Routed experts inside interleaved virtual chunks — the MoE
+        family inherits schedule='1f1b_interleaved' from
+        PipelinedBertMlm like GPT does."""
+        from mpi_tensorflow_tpu.models import bert_pipeline, moe as moe_lib
+
+        mesh = meshlib.make_mesh({"pipe": 2, "data": 2},
+                                 devices=jax.devices()[:4])
+        cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.0)
+        mc = moe_lib.MoeConfig(num_experts=4, every_other=False,
+                               aux_loss_weight=0.0, capacity_factor=8.0)
+        gp = moe_lib.PipelinedMoeBertMlm(cfg, mesh=mesh, moe=mc,
+                                         num_microbatches=2)
+        il = moe_lib.PipelinedMoeBertMlm(cfg, mesh=mesh, moe=mc,
+                                         num_microbatches=2,
+                                         schedule="1f1b_interleaved",
+                                         virtual_stages=2)
+        plain = moe_lib.MoeBertMlm(cfg, moe=mc)
+        params = plain.init(jax.random.key(0))
+        gpp = dict(params)
+        gpp["layers"] = bert_pipeline.stack_layers(params["layers"], 2)
+        gpp = sharding_rules.shard_tree(gpp, gp.logical_axes(), mesh)
+        ilp = dict(params)
+        ilp["layers"] = bert_pipeline.stack_layers_interleaved(
+            params["layers"], 2, 2)
+        ilp = sharding_rules.shard_tree(ilp, il.logical_axes(), mesh)
+        tokens, targets, mask = synthetic.mlm_batches(
+            8, seq_len=16, vocab_size=cfg.vocab_size, seed=0)
+        batch = {"tokens": tokens, "mask": mask}
+        l_gp, _ = gp.loss(gpp, None, batch, targets, train=True)
+        l_il, _ = il.loss(ilp, None, batch, targets, train=True)
+        np.testing.assert_allclose(float(l_il), float(l_gp), rtol=2e-5)
