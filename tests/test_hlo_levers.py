@@ -109,3 +109,53 @@ class TestCostAnalysis:
         assert rbg["bytes"] < base["bytes"]
         # the byte saving is the mask stream: material (>1%), not noise
         assert rbg["bytes"] < base["bytes"] * 0.99
+
+
+class TestDenseAttentionByteScaling:
+    """Hardware-independent half of the flash-crossover question
+    (VERDICT r4 #6): the XLA-dense path's compiled bytes-accessed grows
+    QUADRATICALLY in S (score-matrix materializations), the cost class
+    the flash kernel exists to remove.  Fitting b(S) = C + L*S + Q*S^2
+    from three compiles pins Q and the prediction that the quadratic
+    term dominates by S=4096 — the shipped ``flash_min_seq`` default.
+    Deep tier: three CPU compiles of the 2-layer flagship."""
+
+    def _bytes(self, S, B=2):
+        cfg = Config(precision="bf16")
+        mesh = meshlib.make_mesh(devices=jax.devices()[:1])
+        bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype,
+                          layers=LAYERS, max_positions=max(512, S),
+                          remat=True, flash_min_seq=1 << 30)
+        model = bert.BertMlm(bcfg, mesh=mesh)
+        tx = optax.adamw(1e-4)
+        state = jax.eval_shape(
+            lambda k: gspmd.init_gspmd_state(model, tx, k, mesh),
+            jax.random.key(0))
+        step = gspmd.make_gspmd_train_step(model, mesh, tx)
+        toks = jax.ShapeDtypeStruct((B, S), jnp.int32)
+        mask = jax.ShapeDtypeStruct((B, S), jnp.bool_)
+        labels = jax.ShapeDtypeStruct((B, S), jnp.int32)
+        key = jax.eval_shape(lambda: Config().make_train_key(1))
+        ca = step.lower(state, {"tokens": toks, "mask": mask}, labels,
+                        key).compile().cost_analysis()
+        return float(ca["bytes accessed"])
+
+    def test_quadratic_term_dominates_by_4096(self):
+        s1, s2, s3 = 256, 512, 1024
+        b1, b2, b3 = self._bytes(s1), self._bytes(s2), self._bytes(s3)
+        # solve C + L*S + Q*S^2 through the three points
+        import numpy as _np
+
+        A = _np.array([[1, s, s * s] for s in (s1, s2, s3)], float)
+        C, L, Q = _np.linalg.solve(A, _np.array([b1, b2, b3]))
+        assert Q > 0, f"no quadratic byte term found (Q={Q})"
+        # per-entry sanity: Q spread over layers*B*heads score matrices
+        per_entry = Q / (LAYERS * 2 * 12)
+        assert 4 <= per_entry <= 1024, per_entry   # a few fp32 passes
+        # the crossover claim: at the default flash_min_seq the
+        # quadratic bytes exceed everything else combined
+        S = 4096
+        assert Q * S * S > C + L * S, (
+            f"quadratic share too small at S={S}: "
+            f"{Q * S * S:.3g} vs {C + L * S:.3g} — the flash_min_seq "
+            f"default no longer matches the cost model")

@@ -1418,3 +1418,38 @@ class TestInterleavedSP:
         l_gp, _ = gp.loss(gpp, None, batch, targets, train=True)
         l_il, _ = il.loss(ilp, None, batch, targets, train=True)
         np.testing.assert_allclose(float(l_il), float(l_gp), rtol=2e-5)
+
+    def test_interleaved_remat_matches_gpipe(self):
+        """Stage remat (jax.checkpoint inside _stage) composes with the
+        interleaved schedule; loss parity with rematted GPipe."""
+        import dataclasses as dc
+
+        from mpi_tensorflow_tpu.models import bert_pipeline
+
+        mesh = meshlib.make_mesh({"pipe": 2, "data": 2},
+                                 devices=jax.devices()[:4])
+        cfg = bert.BertConfig(vocab_size=256, hidden=32, layers=4, heads=4,
+                              mlp=64, max_positions=32, dropout=0.1,
+                              remat=True)
+        gp = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh,
+                                            num_microbatches=2)
+        il = bert_pipeline.PipelinedBertMlm(cfg, mesh=mesh,
+                                            num_microbatches=2,
+                                            schedule="1f1b_interleaved",
+                                            virtual_stages=2)
+        plain = bert.BertMlm(dc.replace(cfg, remat=False))
+        params = plain.init(jax.random.key(0))
+        gpp = dict(params)
+        gpp["layers"] = bert_pipeline.stack_layers(params["layers"], 2)
+        gpp = sharding_rules.shard_tree(gpp, gp.logical_axes(), mesh)
+        ilp = dict(params)
+        ilp["layers"] = bert_pipeline.stack_layers_interleaved(
+            params["layers"], 2, 2)
+        ilp = sharding_rules.shard_tree(ilp, il.logical_axes(), mesh)
+        tokens, targets, mask = synthetic.mlm_batches(
+            8, seq_len=16, vocab_size=cfg.vocab_size, seed=0)
+        batch = {"tokens": tokens, "mask": mask}
+        rng = jax.random.key(3)
+        l_gp, _ = gp.loss(gpp, None, batch, targets, rng=rng, train=True)
+        l_il, _ = il.loss(ilp, None, batch, targets, rng=rng, train=True)
+        np.testing.assert_allclose(float(l_il), float(l_gp), rtol=2e-5)
