@@ -159,3 +159,31 @@ class TestDenseAttentionByteScaling:
             f"quadratic share too small at S={S}: "
             f"{Q * S * S:.3g} vs {C + L * S:.3g} — the flash_min_seq "
             f"default no longer matches the cost model")
+
+
+class TestDecodeRooflineModel:
+    """The decode roofline guard (bench.measure_decode) rejects slopes
+    implying less than one full parameter read per token-step.  Pin the
+    premise from the compiled program: the one-token KV-cache decode
+    step's bytes-accessed covers the parameters AND the cache at least
+    once — XLA cannot elide the weight stream.  Deep tier: one CPU
+    compile of the flagship-geometry decode step."""
+
+    def test_step_bytes_cover_params_and_cache(self):
+        from mpi_tensorflow_tpu.models import gpt
+
+        bcfg = dc.replace(bert.BERT_BASE, dtype=jnp.bfloat16)
+        model = gpt.CausalLm(bcfg)
+        params = jax.eval_shape(model.init, jax.random.key(0))
+        Bd, L = 8, 192
+        cache = jax.eval_shape(lambda: model.init_cache(Bd, L))
+        tok = jax.ShapeDtypeStruct((Bd, 1), jnp.int32)
+        step = jax.jit(
+            lambda p, t, c: model.forward_with_cache(p, t, c, 100))
+        ca = step.lower(params, tok, cache).compile().cost_analysis()
+        pb = sum(x.size * x.dtype.itemsize
+                 for x in jax.tree.leaves(params))
+        cb = sum(x.size * x.dtype.itemsize
+                 for x in jax.tree.leaves(cache))
+        assert ca["bytes accessed"] >= pb + cb, (
+            ca["bytes accessed"], pb, cb)
