@@ -21,6 +21,41 @@ NUM_CLASSES = 1000
 def load_splits(data_dir: str = "./data", train_n: int = 2048,
                 test_n: int = 512, image_size: int = IMAGE_SIZE) -> Splits:
     np_dir = os.path.join(data_dir, "imagenet_npy")
+    if not os.path.isdir(np_dir):
+        # real-image path: a class-per-directory JPEG tree is decoded
+        # ONCE into the mmap shard layout (data/imagenet_jpeg.py), then
+        # every epoch streams from mmap with zero per-step decode cost
+        from mpi_tensorflow_tpu.data import imagenet_jpeg
+
+        if imagenet_jpeg.looks_like_tree(data_dir):
+            if not imagenet_jpeg.available():
+                # NEVER silently train on synthetic data when the user
+                # pointed us at real images
+                raise RuntimeError(
+                    f"{data_dir} holds a class-per-directory image tree "
+                    f"but Pillow (PIL) is not installed — install it or "
+                    f"pre-convert to {np_dir} (.npy shards)")
+            import jax
+
+            if jax.process_index() == 0:
+                print(f"[imagenet] decoding JPEG tree under {data_dir} "
+                      f"-> {np_dir} (one-time)", flush=True)
+                imagenet_jpeg.ingest(data_dir, np_dir,
+                                     image_size=image_size)
+            else:
+                # single-writer rule (same as the MNIST download):
+                # process 0 ingests, everyone else waits for the ATOMIC
+                # rename commit — a non-zero rank must never read a
+                # half-written shard dir
+                import time
+
+                deadline = time.time() + 8 * 3600
+                while not os.path.isdir(np_dir):
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"timed out waiting for process 0's JPEG "
+                            f"ingest commit at {np_dir}")
+                    time.sleep(5.0)
     if os.path.isdir(np_dir):
         tr_x = np.load(os.path.join(np_dir, "train_images.npy"), mmap_mode="r")
         tr_y = np.load(os.path.join(np_dir, "train_labels.npy"))
