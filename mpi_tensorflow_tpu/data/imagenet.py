@@ -58,6 +58,12 @@ def load_splits(data_dir: str = "./data", train_n: int = 2048,
                     time.sleep(5.0)
     if os.path.isdir(np_dir):
         tr_x = np.load(os.path.join(np_dir, "train_images.npy"), mmap_mode="r")
+        if tr_x.shape[1] != image_size:
+            raise ValueError(
+                f"{np_dir} holds {tr_x.shape[1]}px shards but this run "
+                f"wants {image_size}px — delete the dir to re-ingest at "
+                f"the new size (serving the wrong resolution silently "
+                f"would train a different model)")
         tr_y = np.load(os.path.join(np_dir, "train_labels.npy"))
         ts_x = np.load(os.path.join(np_dir, "val_images.npy"), mmap_mode="r")
         ts_y = np.load(os.path.join(np_dir, "val_labels.npy"))

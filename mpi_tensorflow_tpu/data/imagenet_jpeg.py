@@ -54,35 +54,54 @@ def available() -> bool:
         return False
 
 
+def _image_class_dirs(base: str) -> list:
+    """Subdirectories of ``base`` that contain at least one image."""
+    out = []
+    if not os.path.isdir(base):
+        return out
+    for d in sorted(os.listdir(base)):
+        cdir = os.path.join(base, d)
+        if not os.path.isdir(cdir) or d.startswith((".", "imagenet_npy")):
+            continue
+        if any(f.lower().endswith(_EXTS) for f in os.listdir(cdir)):
+            out.append(d)
+    return out
+
+
 def looks_like_tree(root: str) -> bool:
     """Whether ``root`` (or ``root/train``) is a class-per-directory
-    image tree — the auto-ingest trigger in data/imagenet.load_splits."""
-    for base in (os.path.join(root, "train"), root):
-        if not os.path.isdir(base):
-            continue
-        for d in os.listdir(base):
-            cdir = os.path.join(base, d)
-            if not os.path.isdir(cdir) or d == "imagenet_npy":
-                continue
-            for fname in os.listdir(cdir):
-                if fname.lower().endswith(_EXTS):
-                    return True
-    return False
+    image tree — the auto-ingest trigger in data/imagenet.load_splits.
+    Requires at least TWO image-bearing class directories: a single
+    stray image-holding subdir (a figures/ folder in a shared ./data)
+    must not trigger an hours-long bogus ingest."""
+    return (len(_image_class_dirs(os.path.join(root, "train"))) >= 2
+            or len(_image_class_dirs(root)) >= 2)
 
 
-def scan_tree(split_dir: str) -> tuple[list, list]:
+def scan_tree(split_dir: str,
+              class_to_id: Optional[dict] = None) -> tuple[list, list]:
     """Class-per-directory scan: returns (paths, labels) with label ids
     assigned by SORTED class-directory name — deterministic across
-    hosts, the property per-host sharding relies on.  The ingest output
-    dir and hidden/tmp dirs are never classes (a flat tree is ingested
-    into a sibling subdirectory; counting it would shift every label
-    after it by one)."""
-    classes = sorted(
-        d for d in os.listdir(split_dir)
-        if os.path.isdir(os.path.join(split_dir, d))
-        and not d.startswith((".", "imagenet_npy")))
+    hosts, the property per-host sharding relies on.  Only directories
+    that actually CONTAIN an image count as classes (an empty or
+    non-image dir must not consume a label id), and the ingest output /
+    hidden / tmp dirs never do.
+
+    ``class_to_id``: an existing name -> id map (the TRAIN split''s) —
+    the val split must label with the train map, never its own sort
+    order (a class-set mismatch between splits would silently misalign
+    every val label); unknown val classes fail loudly."""
+    classes = _image_class_dirs(split_dir)
+    if class_to_id is None:
+        class_to_id = {c: i for i, c in enumerate(classes)}
     paths, labels = [], []
-    for li, cname in enumerate(classes):
+    for cname in classes:
+        if cname not in class_to_id:
+            raise ValueError(
+                f"class directory {cname!r} in {split_dir} does not "
+                f"exist in the training split — the label maps would "
+                f"silently diverge")
+        li = class_to_id[cname]
         cdir = os.path.join(split_dir, cname)
         for fname in sorted(os.listdir(cdir)):
             if fname.lower().endswith(_EXTS):
@@ -116,16 +135,22 @@ def _decoded(paths: list, image_size: int, workers: int):
     import functools
 
     if workers > 1 and len(paths) >= 64:
+        ex = None
         try:
             from concurrent.futures import ProcessPoolExecutor
 
-            with ProcessPoolExecutor(max_workers=workers) as ex:
+            ex = ProcessPoolExecutor(max_workers=workers)
+        except OSError:                      # pragma: no cover
+            ex = None                        # no sem/fork: serial below
+        if ex is not None:
+            # decode errors propagate from here — they must NOT be
+            # caught and retried serially (a mid-stream restart would
+            # write duplicate images at shifted memmap rows)
+            with ex:
                 yield from ex.map(
                     functools.partial(decode_image, image_size=image_size),
                     paths, chunksize=32)
             return
-        except OSError:                      # pragma: no cover
-            pass                             # no sem/fork: fall through
     for p in paths:
         yield decode_image(p, image_size)
 
@@ -162,22 +187,40 @@ def ingest(root: str, out_dir: Optional[str] = None,
     out_dir = out_dir or os.path.join(root, "imagenet_npy")
     train_dir = os.path.join(root, "train")
     val_dir = os.path.join(root, "val")
+    def carve(paths, labels):
+        """Deterministic every-k-th val carve — images leave the train
+        split (never copied: the val shard serves as TEST data and must
+        not overlap training)."""
+        k = max(2, int(round(1.0 / max(val_fraction, 1e-6))))
+        tr = [(p, l) for i, (p, l) in enumerate(zip(paths, labels))
+              if i % k]
+        va = [(p, l) for i, (p, l) in enumerate(zip(paths, labels))
+              if not i % k]
+        return ([p for p, _ in tr], [l for _, l in tr],
+                [p for p, _ in va], [l for _, l in va])
+
     if os.path.isdir(train_dir):
-        tr_p, tr_l = scan_tree(train_dir)
+        # ONE label map, owned by the train split; val labels through it
+        train_classes = _image_class_dirs(train_dir)
+        cmap = {c: i for i, c in enumerate(train_classes)}
+        tr_p, tr_l = scan_tree(train_dir, cmap)
         if os.path.isdir(val_dir):
-            va_p, va_l = scan_tree(val_dir)
+            va_p, va_l = scan_tree(val_dir, cmap)
         else:
-            va_p, va_l = [], []
+            print(f"[imagenet_jpeg] no val/ split under {root}: carving "
+                  f"a deterministic {val_fraction:.0%} of train as val",
+                  flush=True)
+            tr_p, tr_l, va_p, va_l = carve(tr_p, tr_l)
     else:
         paths, labels = scan_tree(root)
-        k = max(2, int(round(1.0 / max(val_fraction, 1e-6))))
-        tr_p = [p for i, p in enumerate(paths) if i % k]
-        tr_l = [l for i, l in enumerate(labels) if i % k]
-        va_p = [p for i, p in enumerate(paths) if not i % k]
-        va_l = [l for i, l in enumerate(labels) if not i % k]
+        tr_p, tr_l, va_p, va_l = carve(paths, labels)
     if not tr_p:
         raise ValueError(f"no images found under {root!r} "
                          f"(expected class-per-directory *.jpeg)")
+    gb = (len(tr_p) + len(va_p)) * image_size * image_size * 3 * 4 / 1e9
+    print(f"[imagenet_jpeg] decoding {len(tr_p)}+{len(va_p)} images -> "
+          f"~{gb:.1f} GB of float32 .npy shards under {out_dir}",
+          flush=True)
     # ATOMIC commit: decode into a tmp dir and rename into place —
     # out_dir's existence is load_splits' done-marker, so a crashed or
     # interrupted ingest must leave nothing behind (a half-written shard
@@ -187,14 +230,7 @@ def ingest(root: str, out_dir: Optional[str] = None,
     os.makedirs(tmp, exist_ok=True)
     try:
         _ingest_split(tr_p, tr_l, tmp, "train", image_size)
-        if va_p:
-            _ingest_split(va_p, va_l, tmp, "val", image_size)
-        else:
-            # load_splits requires a val shard; reuse the first train
-            # images (documented degenerate fallback for tiny trees)
-            _ingest_split(tr_p[:max(1, len(tr_p) // 10)],
-                          tr_l[:max(1, len(tr_l) // 10)], tmp, "val",
-                          image_size)
+        _ingest_split(va_p, va_l, tmp, "val", image_size)
         try:
             os.rename(tmp, out_dir)
         except OSError:

@@ -135,3 +135,61 @@ class TestIngestRobustness:
         monkeypatch.setattr(imagenet_jpeg, "available", lambda: False)
         with pytest.raises(RuntimeError, match="Pillow"):
             imagenet.load_splits(str(tmp_path), image_size=32)
+
+
+class TestLabelMapAndGuards:
+    def test_val_labels_use_train_map(self, tmp_path):
+        """val/ holding a class SUBSET must label through the train map
+        (its own sort order would misalign every label)."""
+        from PIL import Image
+
+        for cname, rgb in (("ant", (10, 10, 10)), ("zebra", (240, 240, 240))):
+            d = tmp_path / "train" / cname
+            os.makedirs(d)
+            for i in range(2):
+                Image.new("RGB", (40, 40), rgb).save(d / f"i{i}.jpeg")
+        vd = tmp_path / "val" / "zebra"     # subset: zebra only
+        os.makedirs(vd)
+        Image.new("RGB", (40, 40), (240, 240, 240)).save(vd / "v.jpeg")
+        out = imagenet_jpeg.ingest(str(tmp_path), image_size=32)
+        val = np.load(os.path.join(out, "val_labels.npy"))
+        assert list(val) == [1]             # zebra = 1 in the TRAIN map
+        vx = np.load(os.path.join(out, "val_images.npy"), mmap_mode="r")
+        assert float(vx[0].mean()) > 0      # the bright image, not ant
+
+    def test_unknown_val_class_fails_loudly(self, tmp_path):
+        from PIL import Image
+
+        d = tmp_path / "train" / "ant"
+        os.makedirs(d)
+        Image.new("RGB", (40, 40), (9, 9, 9)).save(d / "i.jpeg")
+        d2 = tmp_path / "train" / "bee"
+        os.makedirs(d2)
+        Image.new("RGB", (40, 40), (9, 9, 9)).save(d2 / "i.jpeg")
+        vd = tmp_path / "val" / "weird_new_class"
+        os.makedirs(vd)
+        Image.new("RGB", (40, 40), (9, 9, 9)).save(vd / "v.jpeg")
+        with pytest.raises(ValueError, match="does not exist in the"):
+            imagenet_jpeg.ingest(str(tmp_path), image_size=32)
+
+    def test_missing_val_is_carved_not_copied(self, tmp_path):
+        _write_tree(tmp_path, per_class=8, split_dirs=False)
+        out = imagenet_jpeg.ingest(str(tmp_path), image_size=32,
+                                   val_fraction=0.25)
+        tr = np.load(os.path.join(out, "train_images.npy"), mmap_mode="r")
+        va = np.load(os.path.join(out, "val_images.npy"), mmap_mode="r")
+        assert tr.shape[0] + va.shape[0] == 16   # partition, no overlap
+
+    def test_single_stray_image_dir_is_not_a_tree(self, tmp_path):
+        from PIL import Image
+
+        d = tmp_path / "figures"
+        os.makedirs(d)
+        Image.new("RGB", (40, 40), (9, 9, 9)).save(d / "plot.png")
+        assert not imagenet_jpeg.looks_like_tree(str(tmp_path))
+
+    def test_wrong_resolution_shards_fail_loudly(self, tmp_path):
+        _write_tree(tmp_path, per_class=4)
+        imagenet_jpeg.ingest(str(tmp_path), image_size=32)
+        with pytest.raises(ValueError, match="32px shards"):
+            imagenet.load_splits(str(tmp_path), image_size=224)
