@@ -58,12 +58,19 @@ def load_splits(data_dir: str = "./data", train_n: int = 2048,
                     time.sleep(5.0)
     if os.path.isdir(np_dir):
         tr_x = np.load(os.path.join(np_dir, "train_images.npy"), mmap_mode="r")
-        if tr_x.shape[1] != image_size:
+        meta_path = os.path.join(np_dir, "ingest_meta.json")
+        if tr_x.shape[1] != image_size and os.path.exists(meta_path):
+            # shards OUR JPEG ingest produced at another resolution must
+            # not silently satisfy this run; user-provided shards (no
+            # marker) are their own source of truth at any size — the
+            # documented pre-processed-.npy contract.  (Every shipped
+            # ingest writes the marker; marker-less dirs are by
+            # construction user-provided.)
             raise ValueError(
-                f"{np_dir} holds {tr_x.shape[1]}px shards but this run "
-                f"wants {image_size}px — delete the dir to re-ingest at "
-                f"the new size (serving the wrong resolution silently "
-                f"would train a different model)")
+                f"{np_dir} holds {tr_x.shape[1]}px auto-ingested shards "
+                f"but this run wants {image_size}px — delete the dir to "
+                f"re-ingest at the new size (serving the wrong "
+                f"resolution silently would train a different model)")
         tr_y = np.load(os.path.join(np_dir, "train_labels.npy"))
         ts_x = np.load(os.path.join(np_dir, "val_images.npy"), mmap_mode="r")
         ts_y = np.load(os.path.join(np_dir, "val_labels.npy"))

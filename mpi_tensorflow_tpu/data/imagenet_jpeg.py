@@ -79,7 +79,8 @@ def looks_like_tree(root: str) -> bool:
 
 
 def scan_tree(split_dir: str,
-              class_to_id: Optional[dict] = None) -> tuple[list, list]:
+              class_to_id: Optional[dict] = None,
+              classes: Optional[list] = None) -> tuple[list, list]:
     """Class-per-directory scan: returns (paths, labels) with label ids
     assigned by SORTED class-directory name — deterministic across
     hosts, the property per-host sharding relies on.  Only directories
@@ -91,7 +92,8 @@ def scan_tree(split_dir: str,
     the val split must label with the train map, never its own sort
     order (a class-set mismatch between splits would silently misalign
     every val label); unknown val classes fail loudly."""
-    classes = _image_class_dirs(split_dir)
+    if classes is None:
+        classes = _image_class_dirs(split_dir)
     if class_to_id is None:
         class_to_id = {c: i for i, c in enumerate(classes)}
     paths, labels = [], []
@@ -201,15 +203,22 @@ def ingest(root: str, out_dir: Optional[str] = None,
 
     if os.path.isdir(train_dir):
         # ONE label map, owned by the train split; val labels through it
+        # (one listing pass: scan_tree reuses the class list)
         train_classes = _image_class_dirs(train_dir)
         cmap = {c: i for i, c in enumerate(train_classes)}
-        tr_p, tr_l = scan_tree(train_dir, cmap)
+        tr_p, tr_l = scan_tree(train_dir, cmap, classes=train_classes)
+        va_p, va_l = [], []
         if os.path.isdir(val_dir):
             va_p, va_l = scan_tree(val_dir, cmap)
-        else:
-            print(f"[imagenet_jpeg] no val/ split under {root}: carving "
-                  f"a deterministic {val_fraction:.0%} of train as val",
-                  flush=True)
+        if not va_p:
+            # no val/, or a val/ without class-per-directory structure
+            # (the standard ImageNet val tarball extracts FLAT, with
+            # labels in a separate devkit file we cannot infer):
+            # committing a zero-row val shard would permanently serve an
+            # empty test split — carve from train instead, loudly
+            print(f"[imagenet_jpeg] no class-per-directory val split "
+                  f"under {root}: carving a deterministic "
+                  f"{val_fraction:.0%} of train as val", flush=True)
             tr_p, tr_l, va_p, va_l = carve(tr_p, tr_l)
     else:
         paths, labels = scan_tree(root)
@@ -231,6 +240,14 @@ def ingest(root: str, out_dir: Optional[str] = None,
     try:
         _ingest_split(tr_p, tr_l, tmp, "train", image_size)
         _ingest_split(va_p, va_l, tmp, "val", image_size)
+        import json
+
+        with open(os.path.join(tmp, "ingest_meta.json"), "w") as f:
+            # provenance marker: load_splits enforces resolution ONLY on
+            # shards OUR ingest produced — user-provided pre-processed
+            # shards are their own source of truth at any size
+            json.dump({"image_size": image_size,
+                       "train_n": len(tr_p), "val_n": len(va_p)}, f)
         try:
             os.rename(tmp, out_dir)
         except OSError:

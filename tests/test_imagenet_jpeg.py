@@ -191,5 +191,25 @@ class TestLabelMapAndGuards:
     def test_wrong_resolution_shards_fail_loudly(self, tmp_path):
         _write_tree(tmp_path, per_class=4)
         imagenet_jpeg.ingest(str(tmp_path), image_size=32)
-        with pytest.raises(ValueError, match="32px shards"):
+        with pytest.raises(ValueError, match="32px auto-ingested"):
             imagenet.load_splits(str(tmp_path), image_size=224)
+
+    def test_flat_val_dir_carves_from_train(self, tmp_path):
+        """The standard ImageNet val tarball extracts FLAT (no class
+        dirs): ingest must carve val from train, never commit an empty
+        test split."""
+        from PIL import Image
+
+        _write_tree(tmp_path, per_class=8, split_dirs=True)
+        import shutil
+
+        shutil.rmtree(tmp_path / "val")
+        os.makedirs(tmp_path / "val")
+        Image.new("RGB", (40, 40), (5, 5, 5)).save(
+            tmp_path / "val" / "ILSVRC2012_val_1.jpeg")  # flat, no class
+        out = imagenet_jpeg.ingest(str(tmp_path), image_size=32,
+                                   val_fraction=0.25)
+        va = np.load(os.path.join(out, "val_images.npy"), mmap_mode="r")
+        tr = np.load(os.path.join(out, "train_images.npy"), mmap_mode="r")
+        assert va.shape[0] > 0
+        assert tr.shape[0] + va.shape[0] == 16
