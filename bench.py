@@ -398,6 +398,157 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
     }
 
 
+def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
+                    max_slots: int | None = None,
+                    pool_blocks: int | None = None,
+                    block_size: int | None = None, prompt_max: int = 32,
+                    output_max: int = 128, precision: str = "bf16",
+                    seed: int = 0) -> dict:
+    """Continuous-batching serving throughput vs the static-batch
+    ``generate`` baseline, on ONE synthetic Poisson request trace.
+
+    Trace: ``num_requests`` requests, exponential inter-arrivals at
+    ``rate_rps``, prompt lengths uniform in [8, prompt_max], output
+    budgets uniform in [8, output_max] — the mixed-length regime where
+    static batching burns MXU cycles on finished rows (every batch
+    decodes to its LONGEST member) and continuous batching recycles the
+    slot the step a sequence finishes.
+
+    Both arms pay their compiles in an untimed warmup replay (the engine
+    keeps its bucketed jit cache across ``reset``; the baseline warms
+    each padded batch shape), so the timed numbers compare steady-state
+    serving, not compile time.  The baseline ignores arrival stamps
+    (batches start as if all members were already present) — a bias IN
+    THE BASELINE'S FAVOR; continuous batching must beat it anyway.
+    Tokens counted are the REQUESTED output tokens for both arms.
+    """
+    import dataclasses as dc
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mpi_tensorflow_tpu.config import Config
+    from mpi_tensorflow_tpu.models import bert, gpt
+    from mpi_tensorflow_tpu.serving import (PagedDecodeEngine, Request,
+                                            ServeConfig)
+    from mpi_tensorflow_tpu.serving.engine import pow2_ceil
+    from mpi_tensorflow_tpu.serving.paged_cache import blocks_for
+    from mpi_tensorflow_tpu.utils import engagement
+
+    if prompt_max < 1 or output_max < 1 or num_requests < 1:
+        raise ValueError(
+            f"serving trace needs >= 1 request/prompt/output token, got "
+            f"requests={num_requests} prompt_max={prompt_max} "
+            f"output_max={output_max}")
+    cfg = Config(precision=precision)
+    # unset knobs resolve through the run Config's --serve-* defaults
+    # (the one meaning of those knobs — serving.ServeConfig.from_config)
+    max_slots = max_slots if max_slots is not None else cfg.serve_max_slots
+    block_size = (block_size if block_size is not None
+                  else cfg.serve_block_size)
+    bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype)
+    model = gpt.CausalLm(bcfg)
+    params = model.init(jax.random.key(0))
+    rng = np.random.default_rng(seed)
+    p_lo, o_lo = min(8, prompt_max), min(8, output_max)
+    prompts = [list(map(int, rng.integers(0, bcfg.vocab_size, int(n))))
+               for n in rng.integers(p_lo, prompt_max + 1, num_requests)]
+    outputs = [int(n) for n in rng.integers(o_lo, output_max + 1,
+                                            num_requests)]
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, num_requests))
+    arrivals[0] = 0.0
+    max_len = max(len(p) + o for p, o in zip(prompts, outputs))
+    max_seq_len = pow2_ceil(max_len)
+    bps = blocks_for(max_seq_len, block_size)
+    if pool_blocks is None:
+        # fits every slot at full length: measures pure continuous
+        # batching, no eviction churn (shrink to study pressure)
+        pool_blocks = max_slots * bps + 1
+    serve = ServeConfig.from_config(
+        cfg, num_blocks=pool_blocks, block_size=block_size,
+        max_slots=max_slots, max_seq_len=max_seq_len)
+    engine = PagedDecodeEngine(model, params, serve)
+
+    def trace():
+        return [Request(i, prompts[i], outputs[i], float(arrivals[i]))
+                for i in range(num_requests)]
+
+    engagement.reset()
+    engine.run(trace())                       # warmup: pays the compiles
+    warm_compiles = engine.compile_counts()
+    engine.reset()
+    cb = engine.run(trace())
+    steady_compiles = engine.compile_counts()
+
+    # -- static-batch baseline: generate() on arrival-order groups of
+    # max_slots, each padded to its longest prompt and decoded to its
+    # longest output budget, one shared cache capacity per batch --
+    # cache capacity per batch: the group's padded prompt + longest
+    # output (pmax and nmax can come from DIFFERENT requests, so this
+    # may exceed max_seq_len — static batching pays for its padding)
+    gen = jax.jit(
+        lambda p, t, n, L: model.generate(p, t, n, cache_len=L),
+        static_argnums=(2, 3))
+    batches = []
+    for i in range(0, num_requests, max_slots):
+        grp = list(range(i, min(i + max_slots, num_requests)))
+        pmax = pow2_ceil(max(len(prompts[j]) for j in grp))
+        nmax = max(outputs[j] for j in grp)
+        toks = np.zeros((len(grp), pmax), np.int32)
+        for r, j in enumerate(grp):
+            # LEFT-pad by repeating the first token so every row's real
+            # prompt ends at the prefill boundary.  The padded rows'
+            # exact tokens differ from the true continuations (pads are
+            # attended); the baseline measures static batching's COMPUTE
+            # shape — batch-max prompt, batch-max output — not content
+            toks[r] = [prompts[j][0]] * (pmax - len(prompts[j])) \
+                + prompts[j]
+        batches.append((jnp.asarray(toks), nmax, pmax + nmax))
+    for t, n, L in batches:
+        jax.block_until_ready(gen(params, t, n, L))   # warm each shape
+    t0 = time.perf_counter()
+    for t, n, L in batches:
+        jax.block_until_ready(gen(params, t, n, L))
+    static_sec = time.perf_counter() - t0
+    useful = sum(outputs)
+    static_tps = useful / static_sec if static_sec > 0 else 0.0
+
+    return {
+        "model": "gpt_base",
+        "serving_tokens_per_sec": cb["tokens_per_sec"],
+        "p50_token_latency_ms": cb["p50_token_latency_ms"],
+        "p99_token_latency_ms": cb["p99_token_latency_ms"],
+        "static_batch_tokens_per_sec": static_tps,
+        "speedup_vs_static": (cb["tokens_per_sec"] / static_tps
+                              if static_tps > 0 else None),
+        "tokens": cb["tokens"],
+        "elapsed_s": cb["elapsed_s"],
+        "evictions": cb["evictions"],
+        "dispatch_shapes": [list(s) for s in cb["dispatch_shapes"]],
+        "compiles_after_warmup": warm_compiles,
+        "compiles_after_steady": steady_compiles,
+        # None = probe unavailable on this jax (unknown), never "zero"
+        "zero_recompile_steady_state": (
+            warm_compiles == steady_compiles
+            if all(v is not None for v in
+                   {**warm_compiles, **steady_compiles}.values())
+            else None),
+        "paths": engagement.snapshot(),
+        "num_requests": num_requests,
+        "rate_rps": rate_rps,
+        "max_slots": max_slots,
+        "pool_blocks": pool_blocks,
+        "block_size": block_size,
+        "prompt_max": prompt_max,
+        "output_max": output_max,
+        "max_seq_len": max_seq_len,
+        "precision": precision,
+        "platform": jax.devices()[0].platform,
+    }
+
+
 def measure_allreduce(payload_mb: float = 25.4, iters: int = 50,
                       chain: int = 32, dispatches: int = 7) -> dict:
     """Gradient-allreduce step time — the second half of the north-star
@@ -654,6 +805,37 @@ def _stale_score(args, d: dict, item=None):
     requested config: None = not usable, higher = closer config match.
     ``item`` is the queue-item name the record landed under (used to
     infer remat for legacy image rows that predate the ``remat`` key)."""
+    if args.mode == "serving":
+        from mpi_tensorflow_tpu.config import Config
+
+        serve_defaults = Config()     # unset knobs resolve through here,
+                                      # exactly as measure_serving does
+        v = d.get("serving_tokens_per_sec")
+        if v is None or not (0 < v < 1e6):
+            return None
+        if d.get("max_slots") != (args.batch_size
+                                  or serve_defaults.serve_max_slots):
+            return None
+        if d.get("precision") != args.precision:
+            return None
+        if d.get("num_requests") != getattr(args, "requests", 24):
+            return None
+        if d.get("prompt_max") != getattr(args, "prompt_len", 32):
+            return None
+        if d.get("output_max") != getattr(args, "new_tokens", 128):
+            return None
+        if d.get("rate_rps") != getattr(args, "arrival_rate", 4.0):
+            return None          # idle arrival gaps shape tokens/sec
+        want_bs = getattr(args, "serve_block_size", None)
+        if d.get("block_size") != (want_bs if want_bs is not None
+                                   else serve_defaults.serve_block_size):
+            return None
+        want_pool = getattr(args, "serve_pool_blocks", None)
+        # None = the trace-derived default, deterministic for a matching
+        # trace config — only an EXPLICIT pool request must match
+        if want_pool is not None and d.get("pool_blocks") != want_pool:
+            return None
+        return 1
     if args.mode == "decode":
         v = d.get("decode_tokens_per_sec")
         # the round-3 log carries one degenerate decode row (1.02e12
@@ -745,6 +927,19 @@ def _report(args, d: dict, stale: bool = False) -> int:
     measure_*() result dict (for stale: the recorded detail, already
     augmented with the stale provenance fields)."""
     suffix = " [stale: last recorded TPU measurement]" if stale else ""
+    if args.mode == "serving":
+        sp = d.get("speedup_vs_static")
+        _print_json({
+            "metric": f"GPT-base continuous-batching serving throughput "
+                      f"(paged KV cache, Poisson trace){suffix}",
+            "value": round(d["serving_tokens_per_sec"], 1),
+            "unit": "tokens/sec",
+            # >1 = continuous batching beats static-batch generate() on
+            # the same trace (the in-run baseline arm)
+            "vs_baseline": round(sp, 3) if sp else None,
+            "detail": d,
+        })
+        return 0
     if args.mode == "decode":
         kind = (f"beam-{args.num_beams}" if args.num_beams > 0 else "greedy")
         v = d["decode_tokens_per_sec"]
@@ -877,8 +1072,20 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-size", type=int, default=None,
                     help="per-chip batch; default per-model (MODEL_SPECS)")
     ap.add_argument("--mode",
-                    choices=["train", "allreduce", "decode", "hostio"],
+                    choices=["train", "allreduce", "decode", "hostio",
+                             "serving"],
                     default="train")
+    ap.add_argument("--requests", type=int, default=24,
+                    help="serving mode: requests in the Poisson trace")
+    ap.add_argument("--arrival-rate", type=float, default=4.0,
+                    help="serving mode: Poisson arrival rate (req/s)")
+    ap.add_argument("--serve-pool-blocks", type=int, default=None,
+                    help="serving mode: paged-KV pool blocks (default: "
+                         "every slot can reach max length — no "
+                         "eviction churn; shrink to study pressure)")
+    ap.add_argument("--serve-block-size", type=int, default=None,
+                    help="serving mode: cache entries per pool block "
+                         "(default: the run Config's serve_block_size)")
     ap.add_argument("--prompt-len", type=int, default=32,
                     help="decode mode: prompt length")
     ap.add_argument("--new-tokens", type=int, default=128,
@@ -1009,6 +1216,17 @@ def main(argv=None) -> int:
                        "model": args.model, "mode": args.mode},
         })
         return 1
+
+    if args.mode == "serving":
+        r = measure_serving(num_requests=args.requests,
+                            rate_rps=args.arrival_rate,
+                            max_slots=args.batch_size,
+                            pool_blocks=args.serve_pool_blocks,
+                            block_size=args.serve_block_size,
+                            prompt_max=args.prompt_len,
+                            output_max=args.new_tokens,
+                            precision=args.precision)
+        return _report(args, r)
 
     if args.mode == "decode":
         r = measure_decode(batch_size=args.batch_size or 8,

@@ -126,6 +126,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="transformer-family optimizer (lamb = layer-wise "
                         "trust ratios, the large-batch BERT recipe); the "
                         "image families keep the reference's momentum SGD")
+    p.add_argument("--serve-pool-blocks", type=int,
+                   default=d.serve_pool_blocks,
+                   help="serving: paged KV pool size in blocks (block 0 "
+                        "reserved as the null block; serving/paged_cache)")
+    p.add_argument("--serve-block-size", type=int,
+                   default=d.serve_block_size,
+                   help="serving: cache entries per pool block")
+    p.add_argument("--serve-max-slots", type=int,
+                   default=d.serve_max_slots,
+                   help="serving: concurrent sequences (continuous-"
+                        "batching decode batch cap)")
+    p.add_argument("--serve-max-seq-len", type=int,
+                   default=d.serve_max_seq_len,
+                   help="serving: per-request prompt+output cap (sizes "
+                        "the per-sequence block table)")
     p.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
                    default=d.prng_impl,
                    help="dropout-mask PRNG: threefry (JAX default, "
@@ -164,6 +179,10 @@ def config_from_args(args) -> Config:
         pp_schedule=args.pp_schedule,
         virtual_stages=args.virtual_stages,
         param_sharding=args.param_sharding,
+        serve_pool_blocks=args.serve_pool_blocks,
+        serve_block_size=args.serve_block_size,
+        serve_max_slots=args.serve_max_slots,
+        serve_max_seq_len=args.serve_max_seq_len,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
@@ -199,6 +218,20 @@ def main(argv=None) -> int:
             f"transformer families (GSPMD step); the image loop keeps "
             f"the reference's replicated layout and would silently "
             f"ignore it")
+    if args.virtual_stages != Config.virtual_stages \
+            and config.pp_schedule != "1f1b_interleaved":
+        raise SystemExit(
+            f"--virtual-stages {args.virtual_stages} applies only with "
+            f"--pp-schedule 1f1b_interleaved; schedule "
+            f"{config.pp_schedule!r} would silently ignore it")
+    if config.serve_block_size < 1 or config.serve_pool_blocks < 2 \
+            or config.serve_max_slots < 1 or config.serve_max_seq_len < 1:
+        raise SystemExit(
+            f"bad --serve-* geometry: pool-blocks "
+            f"{config.serve_pool_blocks} (>= 2; block 0 is reserved), "
+            f"block-size {config.serve_block_size} (>= 1), max-slots "
+            f"{config.serve_max_slots} (>= 1), max-seq-len "
+            f"{config.serve_max_seq_len} (>= 1)")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

@@ -96,6 +96,16 @@ class Config:
     mesh_shape: Optional[dict] = None  # e.g. {"data": 8}; None = all devices
                                        # on one "data" axis
 
+    # --- serving (continuous-batching decode engine, serving/) ---
+    serve_pool_blocks: int = 128  # paged-KV pool size in blocks (block 0
+                                  # reserved as the null/scratch block);
+                                  # HBM cost = blocks * block_size * 2KV
+                                  # * heads * head_dim * layers * dtype
+    serve_block_size: int = 16    # cache entries per pool block
+    serve_max_slots: int = 8      # concurrent sequences (decode batch cap)
+    serve_max_seq_len: int = 512  # per-request prompt+output cap; also
+                                  # sizes the per-sequence block table
+
     # --- checkpointing (absent from the reference; SURVEY.md §5) ---
     checkpoint_dir: Optional[str] = None   # None = checkpointing off
     resume: bool = False                   # resume from latest in the dir

@@ -37,11 +37,30 @@ def load_splits(data_dir: str = "./data", train_n: int = 2048,
                     f"pre-convert to {np_dir} (.npy shards)")
             import jax
 
+            fail_marker = f"{np_dir}.failed"
             if jax.process_index() == 0:
+                if os.path.exists(fail_marker):
+                    os.unlink(fail_marker)   # stale marker: retrying now
                 print(f"[imagenet] decoding JPEG tree under {data_dir} "
                       f"-> {np_dir} (one-time)", flush=True)
-                imagenet_jpeg.ingest(data_dir, np_dir,
-                                     image_size=image_size)
+                try:
+                    imagenet_jpeg.ingest(data_dir, np_dir,
+                                         image_size=image_size)
+                except BaseException as e:
+                    # commit a failure marker so the non-zero ranks
+                    # polling below fail FAST instead of spinning out
+                    # their 8-hour deadline on an ingest that died
+                    try:
+                        with open(fail_marker, "w") as f:
+                            f.write(f"{type(e).__name__}: {e}\n")
+                    except OSError:
+                        pass         # marker is best-effort; still raise
+                    raise
+                else:
+                    # a marker from a PREVIOUS failed attempt must not
+                    # poison later runs once an ingest has succeeded
+                    if os.path.exists(fail_marker):
+                        os.unlink(fail_marker)
             else:
                 # single-writer rule (same as the MNIST download):
                 # process 0 ingests, everyone else waits for the ATOMIC
@@ -49,8 +68,32 @@ def load_splits(data_dir: str = "./data", train_n: int = 2048,
                 # half-written shard dir
                 import time
 
-                deadline = time.time() + 8 * 3600
+                wait_start = time.time()
+                deadline = wait_start + 8 * 3600
+                marker_seen_absent = not os.path.exists(fail_marker)
                 while not os.path.isdir(np_dir):
+                    # a marker that APPEARS during this wait is this
+                    # cohort's failure by construction: honor it
+                    # immediately.  A marker already present when the
+                    # wait began may be the PREVIOUS run's — process 0
+                    # unlinks it the moment it starts — so honor it only
+                    # after a 60s grace (covers a slow-starting rank 0
+                    # on a quick supervisor restart).  The unlink can
+                    # race every stat/read here; a vanished marker just
+                    # means keep waiting.
+                    try:
+                        fresh = marker_seen_absent or \
+                            time.time() - wait_start > 60.0
+                        if fresh and os.path.exists(fail_marker):
+                            with open(fail_marker) as f:
+                                reason = f.read().strip()
+                            raise RuntimeError(
+                                f"process 0's JPEG ingest failed: "
+                                f"{reason}")
+                    except OSError:
+                        pass
+                    if not os.path.exists(fail_marker):
+                        marker_seen_absent = True
                     if time.time() > deadline:
                         raise RuntimeError(
                             f"timed out waiting for process 0's JPEG "

@@ -176,8 +176,20 @@ def _ingest_split(paths: list, labels: list, out_dir: str, prefix: str,
             np.asarray(labels, np.int64))
 
 
+def _shuffled(paths: list, labels: list, seed: int) -> tuple[list, list]:
+    """Seeded global permutation of (paths, labels), applied BEFORE the
+    shards are written: scan_tree emits strictly class-sorted order, and
+    a class-sorted train shard would make every per-device block and the
+    head-of-shard val carve (data/imagenet.py load_splits) single-class.
+    Seeded so every host that ingests the same tree writes the same
+    shard order (the per-host sharding determinism contract)."""
+    perm = np.random.default_rng(seed).permutation(len(paths))
+    return [paths[i] for i in perm], [labels[i] for i in perm]
+
+
 def ingest(root: str, out_dir: Optional[str] = None,
-           image_size: int = 224, val_fraction: float = 0.04) -> str:
+           image_size: int = 224, val_fraction: float = 0.04,
+           shuffle_seed: int = 0) -> str:
     """Decode a class-per-directory JPEG tree into the mmap `.npy` shard
     layout ``data/imagenet.load_splits`` serves.  Returns ``out_dir``.
 
@@ -185,6 +197,11 @@ def ingest(root: str, out_dir: Optional[str] = None,
     a flat class-per-directory tree (then every ``1/val_fraction``-th
     image, round-robin per class order, becomes the val split — a
     deterministic carve, no RNG).
+
+    Shard order: a seeded global permutation is applied to every split
+    before writing (``shuffle_seed``), so per-device blocks and the val
+    carve in data/imagenet.py are class-balanced instead of inheriting
+    scan_tree's class-sorted order.
     """
     out_dir = out_dir or os.path.join(root, "imagenet_npy")
     train_dir = os.path.join(root, "train")
@@ -206,10 +223,13 @@ def ingest(root: str, out_dir: Optional[str] = None,
         # (one listing pass: scan_tree reuses the class list)
         train_classes = _image_class_dirs(train_dir)
         cmap = {c: i for i, c in enumerate(train_classes)}
-        tr_p, tr_l = scan_tree(train_dir, cmap, classes=train_classes)
+        tr_p, tr_l = _shuffled(*scan_tree(train_dir, cmap,
+                                          classes=train_classes),
+                               seed=shuffle_seed)
         va_p, va_l = [], []
         if os.path.isdir(val_dir):
-            va_p, va_l = scan_tree(val_dir, cmap)
+            va_p, va_l = _shuffled(*scan_tree(val_dir, cmap),
+                                   seed=shuffle_seed + 1)
         if not va_p:
             # no val/, or a val/ without class-per-directory structure
             # (the standard ImageNet val tarball extracts FLAT, with
@@ -221,7 +241,7 @@ def ingest(root: str, out_dir: Optional[str] = None,
                   f"{val_fraction:.0%} of train as val", flush=True)
             tr_p, tr_l, va_p, va_l = carve(tr_p, tr_l)
     else:
-        paths, labels = scan_tree(root)
+        paths, labels = _shuffled(*scan_tree(root), seed=shuffle_seed)
         tr_p, tr_l, va_p, va_l = carve(paths, labels)
     if not tr_p:
         raise ValueError(f"no images found under {root!r} "
@@ -251,8 +271,13 @@ def ingest(root: str, out_dir: Optional[str] = None,
         try:
             os.rename(tmp, out_dir)
         except OSError:
+            if not os.path.isdir(out_dir):
+                # NOT the concurrent-writer race: nothing committed the
+                # destination, so this ingest genuinely failed to land —
+                # swallowing it would silently fall through to synthetic
+                # data (load_splits treats the dir as the done-marker)
+                raise
             # a concurrent writer committed first: theirs is complete
-            pass
     finally:
         import shutil
 

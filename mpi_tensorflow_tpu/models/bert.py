@@ -167,14 +167,21 @@ def rope(x, positions, base: float = 10000.0):
     pair of ``x`` (B, H, S, D) by an angle proportional to its ABSOLUTE
     position, so dot products depend only on RELATIVE offsets
     (rope(q,p1)·rope(k,p2) == rope(q,p1+d)·rope(k,p2+d) — pinned by
-    test).  ``positions``: (S,) int/float absolute positions.  Angles in
-    fp32, output in x.dtype; D must be even."""
+    test).  ``positions``: (S,) int/float absolute positions, or (B, S)
+    per-row positions (the paged decode path, where every sequence sits
+    at its own offset).  Angles in fp32, output in x.dtype; D must be
+    even."""
     D = x.shape[-1]
     half = D // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = jnp.asarray(positions, jnp.float32)[:, None] * freqs[None]
-    cos = jnp.cos(ang)[None, None]                  # (1, 1, S, half)
-    sin = jnp.sin(ang)[None, None]
+    pos = jnp.asarray(positions, jnp.float32)
+    ang = pos[..., None] * freqs                    # (..., S, half)
+    if pos.ndim == 2:
+        cos = jnp.cos(ang)[:, None]                 # (B, 1, S, half)
+        sin = jnp.sin(ang)[:, None]
+    else:
+        cos = jnp.cos(ang)[None, None]              # (1, 1, S, half)
+        sin = jnp.sin(ang)[None, None]
     x1 = x[..., :half].astype(jnp.float32)
     x2 = x[..., half:].astype(jnp.float32)
     return jnp.concatenate([x1 * cos - x2 * sin,

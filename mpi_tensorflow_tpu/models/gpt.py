@@ -28,6 +28,7 @@ from jax import lax
 from mpi_tensorflow_tpu.models import bert as bert_lib
 from mpi_tensorflow_tpu.models import bert_pipeline
 from mpi_tensorflow_tpu.models.bert import _layernorm
+from mpi_tensorflow_tpu.utils import engagement
 
 
 def _shift_targets(tokens):
@@ -157,6 +158,87 @@ class CausalLm(bert_lib.BertMlm):
             + params["mlm"]["out_b"]
         logits = self._constrain(logits, ("batch", "seq", "vocab"))
         return logits.astype(jnp.float32), new_cache
+
+    def forward_paged(self, params, tokens, pools, block_tables, lengths,
+                      valid=None):
+        """Forward ``tokens`` (B, S_in) through the PAGED KV cache: row
+        ``b`` occupies absolute positions [lengths[b], lengths[b]+S_in),
+        reading/writing the per-layer block pools (serving/paged_cache)
+        through its block table.  One implementation serves both serving
+        phases — chunked prefill (S_in = chunk) and single-token decode
+        (S_in = 1) — mirroring how ``forward_with_cache`` serves
+        prefill+decode on the contiguous path.
+
+        pools:        per-layer [{"k", "v"}] block pools, each
+                      (num_blocks, block_size, H, D)
+        block_tables: (B, NB) int32 pool block ids, position order;
+                      entries beyond a row's allocation must be the null
+                      block (0)
+        lengths:      (B,) int32 cache entries already written per row
+        valid:        optional (B, S_in) bool; False lanes (padded
+                      prefill tail, inactive decode slots) scatter into
+                      the null block and their outputs are garbage the
+                      caller discards
+
+        Returns (fp32 logits (B, S_in, V), updated pools).  The math is
+        kept in LOCKSTEP with ``forward_with_cache`` — same shared layer
+        helpers, same fp32 masked-softmax attention over a position-
+        ordered cache view — so greedy decode through this path is
+        token-identical to ``generate`` (pinned by tests/test_serving.py).
+        """
+        from mpi_tensorflow_tpu.ops import paged_attention as paged_ops
+
+        c = self.cfg
+        dt = c.dtype
+        B, S_in = tokens.shape
+        lengths = jnp.asarray(lengths, jnp.int32)
+        pos = lengths[:, None] + jnp.arange(S_in, dtype=jnp.int32)  # (B, S)
+        if valid is None:
+            valid = jnp.ones((B, S_in), bool)
+
+        if c.pos_kind == "rope":
+            h = params["tok_emb"][tokens]
+        else:
+            # same rows dynamic_slice would fetch, but gathered per-row
+            # (each sequence sits at its own offset); clip covers padded
+            # lanes whose nominal position runs past the table
+            h = params["tok_emb"][tokens] \
+                + params["pos_emb"][jnp.clip(pos, 0, c.max_positions - 1)]
+        h = _layernorm(h, params["emb_ln"]).astype(dt)
+        h = self._constrain(h, ("batch", "seq", "embed"))
+
+        qkv_axes = ("batch", "heads", "seq", "head_dim")
+        engagement.record("paged_attention", "gather")
+        new_pools = []
+        for lp, pl in zip(params["layers"], pools):
+            q, k, v = bert_lib.qkv_proj(lp, h, dt, fused=c.fused_qkv)
+            if c.pos_kind == "rope":
+                # rotate at ABSOLUTE per-row positions; keys enter the
+                # pool already rotated (as on the contiguous path)
+                q = bert_lib.rope(q, pos)
+                k = bert_lib.rope(k, pos)
+            q = self._constrain(q, qkv_axes)
+            pk = paged_ops.write_kv(pl["k"], k, block_tables, pos, valid)
+            pv = paged_ops.write_kv(pl["v"], v, block_tables, pos, valid)
+            new_pools.append({"k": pk, "v": pv})
+            ck = paged_ops.gather_kv(pk, block_tables)
+            cv = paged_ops.gather_kv(pv, block_tables)
+            a = paged_ops.paged_attention(q, ck, cv, pos, dt)
+            a = bert_lib.attn_out_proj(lp, a, dt)
+            h = _layernorm(h + a, lp["ln1"]).astype(dt)
+            h = self._constrain(h, ("batch", "seq", "embed"))
+            m = bert_lib.gelu_mlp(
+                lp, h, dt,
+                constrain=lambda m_: self._constrain(
+                    m_, ("batch", "seq", "mlp")))
+            h = _layernorm(h + m, lp["ln2"]).astype(dt)
+            h = self._constrain(h, ("batch", "seq", "embed"))
+
+        t = self.head_hidden(params, h)
+        logits = jnp.einsum("bse,ve->bsv", t, params["tok_emb"].astype(dt)) \
+            + params["mlm"]["out_b"]
+        logits = self._constrain(logits, ("batch", "seq", "vocab"))
+        return logits.astype(jnp.float32), new_pools
 
     def generate(self, params, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int = 0,

@@ -1,0 +1,92 @@
+"""Paged KV-cache device ops: block-table gather/scatter + attention.
+
+The serving path (serving/) stores K/V in a fixed pool of
+``(num_blocks, block_size, heads, head_dim)`` blocks instead of one
+contiguous ``(B, H, max_len, D)`` buffer per request batch
+(models/gpt.init_cache).  Each live sequence owns an ordered list of
+pool blocks (its block table); block ``j`` of a sequence holds absolute
+positions ``[j*block_size, (j+1)*block_size)``, so a gather of the table
+reconstructs the contiguous layout and the attention math can stay
+IDENTICAL to the contiguous decode path — the token-parity guarantee
+(tests/test_serving.py) rests on that: same einsum contraction order,
+same fp32 masked softmax, with padding lanes exactly zeroed
+(``exp(finfo.min - max)`` underflows to 0.0, and 0-weighted V lanes add
+exact 0.0 terms).
+
+Block 0 is the NULL block: never allocated to a sequence, it absorbs
+scatter writes from masked-out lanes (padded prefill tail, inactive
+decode slots) so those lanes need no branching — garbage lands in
+scratch, reads of it are masked by the causal visibility test.
+
+All ops are plain XLA gather/scatter + einsum (TPU-lowerable, CPU-exact
+for tests); a Pallas kernel can slot in behind ``paged_attention``
+without touching callers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NULL_BLOCK = 0
+
+
+def write_kv(pool, kv, block_table, positions, valid):
+    """Scatter per-token K or V vectors into the block pool.
+
+    pool:        (num_blocks, block_size, H, D)
+    kv:          (B, H, S, D)  — new keys or values, head-major like the
+                 qkv projection emits
+    block_table: (B, NB) int32 — pool block ids, position order
+    positions:   (B, S) int32 — absolute position of each token
+    valid:       (B, S) bool — False lanes scatter into the null block
+
+    Returns the updated pool.  Lanes of distinct sequences never collide
+    (the allocator hands each block to one sequence); invalid lanes all
+    land in block 0, whose contents are never read unmasked.
+    """
+    bs = pool.shape[1]
+    nb = block_table.shape[1]
+    blk_idx = jnp.clip(positions // bs, 0, nb - 1)
+    blk = jnp.take_along_axis(block_table, blk_idx, axis=1)      # (B, S)
+    blk = jnp.where(valid, blk, NULL_BLOCK)
+    off = positions % bs
+    vals = jnp.transpose(kv, (0, 2, 1, 3))                       # (B, S, H, D)
+    return pool.at[blk, off].set(vals.astype(pool.dtype))
+
+
+def gather_kv(pool, block_table):
+    """Reassemble a (B, H, L, D) contiguous view from the pool.
+
+    L = NB * block_size; entry ``l`` holds the sequence's absolute
+    position ``l`` (block tables are position-ordered), so the causal
+    visibility test against absolute query positions carries over
+    unchanged from the contiguous path.
+    """
+    g = pool[block_table]                        # (B, NB, bs, H, D)
+    B, NB, bs, H, D = g.shape
+    return jnp.transpose(g.reshape(B, NB * bs, H, D), (0, 2, 1, 3))
+
+
+def paged_attention(q, ck, cv, q_positions, dt):
+    """Masked causal attention over a gathered paged cache.
+
+    q:           (B, H, S, D) query block (S=1 decode, S=chunk prefill)
+    ck, cv:      (B, H, L, D) gathered keys/values (gather_kv)
+    q_positions: (B, S) absolute positions of the queries
+    dt:          compute dtype for the probability @ V contraction
+
+    Math kept in LOCKSTEP with models/gpt.forward_with_cache (cast to
+    fp32 BEFORE the scale, scale folded into the masked select, softmax
+    in fp32, probabilities cast back to ``dt``): the greedy token-parity
+    test pins this path to the contiguous one bit-for-bit on CPU.
+    """
+    L = ck.shape[2]
+    scale = q.shape[-1] ** -0.5
+    col = jnp.arange(L)
+    # (B, S, L): key position <= query position, per row
+    vis = col[None, None, :] <= q_positions[:, :, None]
+    s = jnp.einsum("bhsd,bhld->bhsl", q, ck).astype(jnp.float32)
+    s = jnp.where(vis[:, None], s * scale, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    return jnp.einsum("bhsl,bhld->bhsd", p, cv)

@@ -1,0 +1,25 @@
+"""Continuous-batching serving subsystem (Orca / vLLM lineage).
+
+Three cooperating layers, host-side policy over device-side math:
+
+- ``paged_cache``  — fixed device pool of KV blocks + the host block
+                     allocator; memory scales with LIVE tokens, not
+                     ``batch x max_len`` (vs models/gpt.init_cache).
+- ``scheduler``    — request queue, admit-on-free-blocks, per-step slot
+                     recycling on EOS/budget, eviction under pressure.
+- ``engine``       — chunked prefill + single-token decode steps at a
+                     small fixed set of bucketed shapes (powers of two),
+                     with the block pool donated through every dispatch
+                     so steady-state serving updates the cache in place
+                     and never recompiles after bucket warmup.
+
+The decode math itself lives in models/gpt.CausalLm.forward_paged (the
+shared transformer stack) and ops/paged_attention (gather/scatter).
+"""
+
+from mpi_tensorflow_tpu.serving.engine import (  # noqa: F401
+    PagedDecodeEngine, ServeConfig)
+from mpi_tensorflow_tpu.serving.paged_cache import (  # noqa: F401
+    BlockAllocator, init_pools)
+from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401
+    Request, Scheduler)

@@ -1,0 +1,84 @@
+"""Paged KV cache: host block allocator + device pool construction.
+
+The device side is a fixed pool of ``(num_blocks, block_size, heads,
+head_dim)`` K and V blocks per transformer layer (ops/paged_attention
+reads/writes it through per-sequence block tables).  The host side —
+this module — owns WHICH block belongs to WHOM: a free-list allocator
+whose accounting the scheduler's admit/evict decisions hang off.
+
+Block 0 is reserved as the null/scratch block (masked-lane scatter
+target, ops/paged_attention.NULL_BLOCK) and is never handed out.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class BlockAllocator:
+    """Free-list allocator over pool block ids ``1..num_blocks-1``.
+
+    Pure host Python (no jax import): the scheduler tests exercise
+    admit/evict accounting without a device.  LIFO reuse keeps recently
+    freed blocks hot in whatever cache hierarchy the pool lives in.
+    """
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError(
+                f"need >= 2 blocks (block 0 is the reserved null block), "
+                f"got {num_blocks}")
+        self.num_blocks = num_blocks
+        self._free: List[int] = list(range(num_blocks - 1, 0, -1))
+        self._used: set = set()
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used(self) -> int:
+        return len(self._used)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def alloc(self, n: int) -> List[int]:
+        """Take ``n`` blocks; raises when the pool cannot cover them —
+        callers gate on ``can_alloc`` (admission) or evict first."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"block pool exhausted: want {n}, have {len(self._free)} "
+                f"free of {self.num_blocks - 1}")
+        out = [self._free.pop() for _ in range(n)]
+        self._used.update(out)
+        return out
+
+    def free(self, ids: List[int]) -> None:
+        for b in ids:
+            if b not in self._used:
+                raise ValueError(f"double free / foreign block id {b}")
+            self._used.remove(b)
+            self._free.append(b)
+
+    def check(self) -> None:
+        """Invariant: every non-null block is free xor used, once."""
+        assert len(self._free) + len(self._used) == self.num_blocks - 1
+        assert len(set(self._free)) == len(self._free)
+        assert not (set(self._free) & self._used)
+
+
+def blocks_for(tokens: int, block_size: int) -> int:
+    """Pool blocks needed to hold ``tokens`` cache entries."""
+    return -(-tokens // block_size)
+
+
+def init_pools(cfg, num_blocks: int, block_size: int) -> list:
+    """Per-layer K/V block pools (zeros), mirroring the per-layer
+    ``{"k", "v"}`` pytree shape of models/gpt.init_cache so the engine
+    threads them through jit the same way."""
+    import jax.numpy as jnp
+
+    z = jnp.zeros((num_blocks, block_size, cfg.heads, cfg.head_dim),
+                  cfg.dtype)
+    return [{"k": z, "v": z} for _ in range(cfg.layers)]

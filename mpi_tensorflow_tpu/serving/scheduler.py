@@ -1,0 +1,184 @@
+"""Continuous-batching scheduler: request queue + decode-slot state.
+
+Orca-style iteration-level scheduling (Yu et al., OSDI 2022): the unit
+of work is ONE decode step over whatever sequences are live, not one
+request batch end-to-end.  A sequence joins as soon as a slot AND the
+blocks for its prompt are free (admit-on-free-blocks), and its slot is
+recycled the step it finishes (EOS or token budget) — a long request no
+longer holds a whole batch hostage, and finished rows stop burning MXU
+cycles on masked steps.
+
+All state here is host-side Python; the engine turns the live slot set
+into bucketed device dispatches.  Pure-Python on purpose: the
+admit/evict invariant tests run without a device.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import List, Optional
+
+from mpi_tensorflow_tpu.serving.paged_cache import (BlockAllocator,
+                                                    blocks_for)
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.  ``arrival`` is in seconds on the caller's
+    clock; the engine admits a request only once the clock passes it
+    (the bench harness replays Poisson traces through this)."""
+    id: int
+    prompt: List[int]
+    max_new_tokens: int
+    arrival: float = 0.0
+
+
+@dataclasses.dataclass
+class Sequence:
+    """A live (admitted) sequence: its pool blocks + progress."""
+    request: Request
+    block_ids: List[int]
+    prefilled: int = 0            # prompt tokens already through prefill
+    generated: List[int] = dataclasses.field(default_factory=list)
+    done: bool = False
+
+    @property
+    def length(self) -> int:
+        """Prompt tokens prefilled + tokens generated.  The LAST
+        generated token is pending — emitted but not yet written to the
+        cache (the next decode step writes it at position length-1 as it
+        reads it), so the cache holds ``length - 1`` entries between
+        steps."""
+        return self.prefilled + len(self.generated)
+
+
+class Scheduler:
+    """Slots + queue + the block-accounting policy.
+
+    ``max_slots`` bounds concurrent sequences (the decode batch
+    dimension); ``max_blocks_per_seq`` bounds one sequence's table (the
+    gathered attention capacity).  Admission requires a free slot AND
+    enough free blocks for the whole prompt plus one decode block — a
+    sequence that prefills must be able to emit at least one token.
+
+    Under pool pressure (a decode step needs a new block and none is
+    free) the YOUNGEST sequence is evicted back to the queue head —
+    restart-from-scratch preemption, blocks freed, FIFO fairness for the
+    oldest.  Invariants (pinned by tests): a block belongs to at most
+    one live sequence; evicted/finished sequences return every block;
+    free+used always partitions the pool.
+    """
+
+    def __init__(self, allocator: BlockAllocator, max_slots: int,
+                 block_size: int, max_blocks_per_seq: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.allocator = allocator
+        self.max_slots = max_slots
+        self.block_size = block_size
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self.waiting: deque = deque()
+        self.slots: List[Optional[Sequence]] = [None] * max_slots
+        self.finished: List[Sequence] = []
+        self.evictions = 0
+        self.evicted_ids: List[int] = []   # request ids, drained by the
+                                           # engine's latency accounting
+
+    # ---------------- queue / admission ----------------
+
+    def submit(self, req: Request) -> None:
+        if not req.prompt or req.max_new_tokens < 1:
+            raise ValueError(
+                f"request {req.id}: needs a non-empty prompt and "
+                f"max_new_tokens >= 1")
+        total = len(req.prompt) + req.max_new_tokens
+        cap = self.max_blocks_per_seq * self.block_size
+        if total > cap:
+            raise ValueError(
+                f"request {req.id}: prompt+output {total} exceeds the "
+                f"per-sequence cache capacity {cap} "
+                f"({self.max_blocks_per_seq} blocks x {self.block_size})")
+        self.waiting.append(req)
+
+    def free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
+
+    def admit(self) -> List[int]:
+        """Admit queued requests while a slot and blocks are free.
+        Returns the slot indices admitted this call (they need prefill).
+        FIFO head-of-line: if the oldest request does not fit, nothing
+        behind it jumps the queue — admission order stays arrival order
+        (the latency numbers the bench reports depend on it)."""
+        admitted = []
+        while self.waiting:
+            slot = self.free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            need = blocks_for(len(req.prompt) + 1, self.block_size)
+            if not self.allocator.can_alloc(need):
+                break
+            self.waiting.popleft()
+            self.slots[slot] = Sequence(req, self.allocator.alloc(need))
+            admitted.append(slot)
+        return admitted
+
+    # ---------------- per-step bookkeeping ----------------
+
+    def live_slots(self) -> List[int]:
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and s.prefilled > 0]
+
+    def ensure_block(self, slot: int) -> bool:
+        """Make sure the slot's table covers cache position ``length-1``
+        (where this step writes the pending token, growing the cache to
+        ``length`` entries).  Returns False when the pool is exhausted
+        AND eviction could not free a block for this slot."""
+        seq = self.slots[slot]
+        need = blocks_for(seq.length, self.block_size)
+        while len(seq.block_ids) < need:
+            if not self.allocator.can_alloc(1):
+                if not self._evict_youngest(protect=slot):
+                    return False
+                continue
+            seq.block_ids.extend(self.allocator.alloc(1))
+        return True
+
+    def _evict_youngest(self, protect: int) -> bool:
+        """Preempt the youngest live sequence (restart-from-scratch):
+        free its blocks, requeue its request at the queue HEAD so it
+        re-admits before anything that arrived after it."""
+        candidates = [(self.slots[i].request.arrival, i)
+                      for i in range(self.max_slots)
+                      if self.slots[i] is not None and i != protect]
+        if not candidates:
+            return False
+        _, victim = max(candidates)
+        seq = self.slots[victim]
+        self.allocator.free(seq.block_ids)
+        self.waiting.appendleft(seq.request)
+        self.slots[victim] = None
+        self.evictions += 1
+        self.evicted_ids.append(seq.request.id)
+        return True
+
+    def record_token(self, slot: int, token: int,
+                     eos_id: Optional[int] = None) -> None:
+        """Account one generated token; finish + recycle the slot when
+        the sequence hits EOS or its budget."""
+        seq = self.slots[slot]
+        seq.generated.append(token)
+        if (len(seq.generated) >= seq.request.max_new_tokens
+                or (eos_id is not None and token == eos_id)):
+            seq.done = True
+            self.allocator.free(seq.block_ids)
+            seq.block_ids = []
+            self.finished.append(seq)
+            self.slots[slot] = None
+
+    def all_done(self) -> bool:
+        return not self.waiting and all(s is None for s in self.slots)

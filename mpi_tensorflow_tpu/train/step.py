@@ -89,24 +89,27 @@ def _sync_step_body(model, config, schedule):
     loss_fn = make_loss_fn(model, config)
     accum = int(getattr(config, "grad_accum", 1) or 1)
 
+    # differentiate w.r.t. a 'data'-varying view of the params so the
+    # backward pass yields LOCAL grads, then allreduce ONCE, explicitly
+    # (lax.psum below).  Both accum paths share the pattern; relying on
+    # the autodiff transpose of replicated params to emit the psum would
+    # tie the gradient semantics to shard_map's replication machinery
+    # (and silently break on jaxlibs without it — utils/jaxcompat.pcast)
+    to_varying = lambda t: jax.tree.map(
+        lambda x: lax.pcast(x, "data", to="varying"), t)
+
     def grads_of(params, model_state, batch, labels, rng):
         if accum <= 1:
-            return jax.value_and_grad(loss_fn, has_aux=True)(
-                params, model_state, batch, labels, rng)
+            (loss, new_ms), g = jax.value_and_grad(loss_fn, has_aux=True)(
+                to_varying(params), model_state, batch, labels, rng)
+            g = jax.tree.map(lambda x: lax.psum(x, "data"), g)
+            return (loss, new_ms), g
         n = batch.shape[0]
         if n % accum:
             raise ValueError(
                 f"per-shard batch {n} not divisible by grad_accum {accum}")
         mb = batch.reshape(accum, n // accum, *batch.shape[1:])
         ml = labels.reshape(accum, n // accum, *labels.shape[1:])
-
-        # differentiate w.r.t. a 'data'-varying view of the params so each
-        # microbatch yields LOCAL grads (no per-microbatch allreduce); one
-        # psum after the scan restores the replicated type the caller
-        # expects from the accum=1 path (where the autodiff transpose of
-        # the replicated params emits the psum itself)
-        to_varying = lambda t: jax.tree.map(
-            lambda x: lax.pcast(x, "data", to="varying"), t)
         p_local = to_varying(params)
 
         def micro(carry, xs):
@@ -134,11 +137,9 @@ def _sync_step_body(model, config, schedule):
         rng = jax.random.fold_in(rng, state.opt.step.astype(jnp.int32))
         (loss, new_mstate), grads = grads_of(
             state.params, state.model_state, batch, labels, rng)
-        # shard_map autodiff inserts the gradient allreduce itself: the
-        # cotangent of the replicated params is psum'd across 'data' (this IS
-        # the reference's intended MPI.Allreduce, emitted by the transpose
-        # rule).  grads therefore hold sum_s(local-mean grad_s); normalize by
-        # the axis size to get the global-batch mean gradient.
+        # grads_of allreduces explicitly (this IS the reference's intended
+        # MPI.Allreduce): grads hold sum_s(local-mean grad_s); normalize
+        # by the axis size to get the global-batch mean gradient.
         grads = jax.tree.map(lambda g: g / lax.axis_size("data"), grads)
         loss = collectives.allreduce_mean(loss, "data")
         # cross-replica batch-stat averaging keeps model state replicated

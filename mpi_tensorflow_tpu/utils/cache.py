@@ -102,71 +102,35 @@ def _jaxlib_version() -> str:
         return "unknown"
 
 
-def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
-    """True when this box can reload its OWN XLA:CPU AOT cache entries.
+def _persistent_probe(memo: dict, memo_key, verdict_path: str,
+                      valid_verdicts, probe_fn):
+    """THE shared probe-once contract for subprocess canaries: memoized
+    per process (``memo[memo_key]`` holds the finished verdict string or
+    None), persisted across processes at ``verdict_path``.  Invariants
+    every caller gets from this one copy:
 
-    Compiles a small gather-containing jit in one subprocess (writing the
-    entry into a throwaway dir), reloads it in a second, and checks the
-    second's stderr for the AOT loader's machine-type mismatch warning —
-    the signature of the same-host tuning-attribute hazard that aborted
-    the round-4 suite.  The verdict persists next to the scoped dir,
-    keyed by the jaxlib version (a loader upgrade re-probes), and is
-    memoized per (ISA tag, version) in-process so multiple cache bases
-    in one session pay ONE probe.  A canary INFRASTRUCTURE failure
-    (compile subprocess fails/times out) reports False for this session
-    but is NOT persisted — the next session retries; only a completed
-    probe writes a verdict."""
-    tag = os.path.basename(os.path.normpath(scoped_dir))
-    ver = _jaxlib_version()
-    memo_key = (tag, ver)
-    if memo_key in _ROUNDTRIP_MEMO:
-        return _ROUNDTRIP_MEMO[memo_key]
-    verdict_path = f"{os.path.normpath(scoped_dir)}.{ver}.roundtrip"
+    - a persisted verdict in ``valid_verdicts`` short-circuits; a
+      torn/garbage file (reader raced a non-atomic writer from an older
+      version) falls through to a re-probe;
+    - only a COMPLETED probe (``probe_fn`` returns a verdict string)
+      publishes — an infrastructure failure (returns None) reports for
+      this session only, so the next session retries;
+    - publish is atomic (tmp + os.replace): a racing reader sees the old
+      state or the full verdict, never a torn file.
+    """
+    if memo_key in memo:
+        return memo[memo_key]
     if os.path.exists(verdict_path):
-        with open(verdict_path) as f:
-            content = f.read().strip()
-        if content in ("safe", "unsafe"):
-            safe = content == "safe"
-            _ROUNDTRIP_MEMO[memo_key] = safe
-            return safe
-        # torn/garbage file (e.g. a reader raced a non-atomic writer from
-        # an older version): fall through and re-probe
-
-    import subprocess
-    import tempfile
-
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)   # never touch the tunnel here
-    env["JAX_PLATFORMS"] = "cpu"
-    cache = tempfile.mkdtemp(prefix="canary-", dir=os.path.dirname(
-        os.path.normpath(scoped_dir)) or ".")
-    verdict = None                           # None = probe did not complete
-    try:
-        r1 = subprocess.run([sys.executable, "-c", _CANARY, cache],
-                            capture_output=True, text=True, env=env,
-                            timeout=timeout)
-        if r1.returncode == 0 and "CANARY_OK" in r1.stdout:
-            r2 = subprocess.run([sys.executable, "-c", _CANARY, cache],
-                                capture_output=True, text=True, env=env,
-                                timeout=timeout)
-            if r2.returncode == 0 and "CANARY_OK" in r2.stdout \
-                    and "doesn't match the machine type" not in r2.stderr \
-                    and "supported on the host machine" not in r2.stderr:
-                verdict = "safe"
-            else:
-                # the reload leg itself warned or crashed: THE hazard
-                verdict = "unsafe"
-        # r1 failing is an infrastructure problem, not a reload verdict
-    except Exception:
-        pass                                 # fail-safe: cache off
-    finally:
-        import shutil
-
-        shutil.rmtree(cache, ignore_errors=True)
+        try:
+            with open(verdict_path) as f:
+                content = f.read().strip()
+        except OSError:
+            content = ""
+        if content in valid_verdicts:
+            memo[memo_key] = content
+            return content
+    verdict = probe_fn()
     if verdict is not None:
-        # atomic publish: a reader racing the write must see the old
-        # state or the full verdict, never a torn file ('' != 'safe'
-        # would silently disable the cache for this jaxlib version)
         tmp = f"{verdict_path}.tmp.{os.getpid()}"
         try:
             with open(tmp, "w") as f:
@@ -177,9 +141,109 @@ def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
                 os.unlink(tmp)       # no stray tmp on ENOSPC/races
             except OSError:
                 pass
-    safe = verdict == "safe"
-    _ROUNDTRIP_MEMO[memo_key] = safe
-    return safe
+    memo[memo_key] = verdict
+    return verdict
+
+
+def cpu_cache_roundtrip_safe(scoped_dir: str, timeout: int = 180) -> bool:
+    """True when this box can reload its OWN XLA:CPU AOT cache entries.
+
+    Compiles a small gather-containing jit in one subprocess (writing the
+    entry into a throwaway dir), reloads it in a second, and checks the
+    second's stderr for the AOT loader's machine-type mismatch warning —
+    the signature of the same-host tuning-attribute hazard that aborted
+    the round-4 suite.  The verdict persists next to the scoped dir,
+    keyed by the jaxlib version (a loader upgrade re-probes), and is
+    memoized per (ISA tag, version) in-process so multiple cache bases
+    in one session pay ONE probe; canary-infrastructure failures report
+    False without persisting (_persistent_probe contract)."""
+    tag = os.path.basename(os.path.normpath(scoped_dir))
+    ver = _jaxlib_version()
+
+    def probe():
+        import subprocess
+        import tempfile
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        cache = tempfile.mkdtemp(prefix="canary-", dir=os.path.dirname(
+            os.path.normpath(scoped_dir)) or ".")
+        try:
+            r1 = subprocess.run([sys.executable, "-c", _CANARY, cache],
+                                capture_output=True, text=True, env=env,
+                                timeout=timeout)
+            if r1.returncode == 0 and "CANARY_OK" in r1.stdout:
+                r2 = subprocess.run([sys.executable, "-c", _CANARY, cache],
+                                    capture_output=True, text=True,
+                                    env=env, timeout=timeout)
+                if r2.returncode == 0 and "CANARY_OK" in r2.stdout \
+                        and "doesn't match the machine type" \
+                        not in r2.stderr \
+                        and "supported on the host machine" \
+                        not in r2.stderr:
+                    return "safe"
+                # the reload leg itself warned or crashed: THE hazard
+                return "unsafe"
+            # r1 failing is infrastructure, not a reload verdict
+            return None
+        except Exception:
+            return None                        # fail-safe: cache off
+        finally:
+            import shutil
+
+            shutil.rmtree(cache, ignore_errors=True)
+
+    verdict = _persistent_probe(
+        _ROUNDTRIP_MEMO, (tag, ver),
+        f"{os.path.normpath(scoped_dir)}.{ver}.roundtrip",
+        ("safe", "unsafe"), probe)
+    return verdict == "safe"
+
+
+_FLAGS_MEMO: dict = {}       # (flags, jaxlib ver) -> bool, per process
+
+
+def xla_flags_supported(flags: str, timeout: int = 180) -> bool:
+    """True when the installed XLA accepts every entry in ``flags``.
+
+    XLA hard-aborts the whole process at client init on an unknown
+    XLA_FLAGS entry (parse_flags_from_env: "Unknown flags in XLA_FLAGS")
+    — there is no graceful in-process probe, so try them in a throwaway
+    subprocess.  The verdict persists in the system temp dir keyed by
+    the jaxlib version and a flags hash (a jaxlib upgrade re-probes);
+    memoization/persistence semantics are _persistent_probe's."""
+    import tempfile
+
+    ver = _jaxlib_version()
+    tag = hashlib.sha1(flags.encode()).hexdigest()[:12]
+
+    def probe():
+        import subprocess
+
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the tunnel
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = flags
+        try:
+            r = subprocess.run([sys.executable, "-c",
+                                "import jax; jax.devices()"],
+                               capture_output=True, text=True, env=env,
+                               timeout=timeout)
+        except Exception:
+            return None
+        if r.returncode == 0:
+            return "ok"
+        if "Unknown flags in XLA_FLAGS" in (r.stderr or ""):
+            return "unknown-flag"
+        return None    # other nonzero rcs are infrastructure noise
+
+    verdict = _persistent_probe(
+        _FLAGS_MEMO, (flags, ver),
+        os.path.join(tempfile.gettempdir(),
+                     f"xla-flags-{tag}.{ver}.verdict"),
+        ("ok", "unknown-flag"), probe)
+    return verdict == "ok"
 
 
 def gated_cpu_cache(base: str):

@@ -1,0 +1,91 @@
+"""Version compatibility shims for the installed jax.
+
+The repo is written against the current jax surface (``jax.shard_map``
+with ``check_vma``, ``lax.axis_size``); older jaxlibs ship the same
+machinery under ``jax.experimental.shard_map.shard_map`` (keyword
+``check_rep``) and expose a mapped axis's static size only through
+``jax._src.core.axis_frame``.  One shim, installed once at package
+import, keeps every call site — modules, tests, bench, scripts — on the
+one modern spelling instead of scattering per-site fallbacks.
+"""
+
+from __future__ import annotations
+
+# names of shims this jax actually needed; empty on a modern jax.
+# Consumers (tests) use truthiness as "running on a legacy jaxlib" —
+# e.g. to skip the ZeRO-1 x PP suite whose graphs segfault (process-
+# fatal) in the old tracer.
+LEGACY_SHIMS: list = []
+
+
+def install() -> None:
+    """Install every shim the running jax needs (each one a no-op when
+    the modern surface is already present)."""
+    _ensure_shard_map()
+    _ensure_axis_size()
+    _ensure_pcast()
+
+
+def _ensure_shard_map() -> None:
+    """Make ``jax.shard_map(..., check_vma=...)`` work on this jax."""
+    import jax
+
+    if getattr(jax, "shard_map", None) is not None:
+        return
+
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f, /, *, mesh, in_specs, out_specs, check_vma=None,
+                  **kw):
+        # the old check_rep inference is strictly weaker than modern
+        # check_vma (it cannot see through psum-into-replicated, which
+        # the train-step call sites rely on), so an unspecified check
+        # maps to False rather than the old True default
+        kw.setdefault("check_rep",
+                      False if check_vma is None else check_vma)
+        return _legacy(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+    jax.shard_map = shard_map
+    LEGACY_SHIMS.append("shard_map")
+
+
+def _ensure_axis_size() -> None:
+    """Make ``jax.lax.axis_size(name)`` work on this jax: the old
+    ``axis_frame`` lookup already returns the STATIC Python int the call
+    sites rely on (loop bounds, jnp.arange lengths)."""
+    from jax import lax
+
+    if getattr(lax, "axis_size", None) is not None:
+        return
+
+    from jax._src import core
+
+    def axis_size(axis_name):
+        return core.axis_frame(axis_name)
+
+    lax.axis_size = axis_size
+    LEGACY_SHIMS.append("axis_size")
+
+
+def _ensure_pcast() -> None:
+    """Make ``lax.pcast(x, axis, to="varying")`` work on this jax.
+
+    Old shard_map has no varying-manual-axes (VMA) type system at all —
+    with its ``check_rep=False`` every value is effectively already
+    per-shard data, so the modern replicated->varying cast is an
+    identity.  Callers must pair it with an EXPLICIT psum over the
+    gradient (train/step.py does): on legacy jax the transpose of a
+    replicated shard_map input does NOT insert the allreduce the modern
+    VMA machinery provides."""
+    from jax import lax
+
+    if getattr(lax, "pcast", None) is not None:
+        return
+
+    def pcast(x, axis_name, *, to):
+        del axis_name, to
+        return x
+
+    lax.pcast = pcast
+    LEGACY_SHIMS.append("pcast")

@@ -24,7 +24,13 @@ _force_virtual_cpu_env(os.environ, 8)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax has no jax_num_cpu_devices option; the XLA_FLAGS
+    # --xla_force_host_platform_device_count route set by
+    # _force_virtual_cpu_env above still yields the 8-device platform
+    pass
 
 # Persistent compilation cache: the transformer-path compiles dominate the
 # suite's wall clock (VERDICT r1: ~18 min); cached compiles make repeat runs

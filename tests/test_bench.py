@@ -322,6 +322,26 @@ class TestMeasureDecode:
         assert r["timing_degenerate"] or r["decode_tokens_per_sec"] > 0
 
 
+class TestMeasureServing:
+    def test_serving_detail_and_zero_recompiles(self, monkeypatch):
+        """measure_serving on a tiny trace: emits both arms' numbers,
+        and the steady-state replay adds no compiles over warmup."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_serving(num_requests=3, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=8,
+                                  precision="fp32")
+        assert r["serving_tokens_per_sec"] > 0
+        assert r["static_batch_tokens_per_sec"] > 0
+        assert r["speedup_vs_static"] > 0
+        assert r["zero_recompile_steady_state"], r
+        assert r["p99_token_latency_ms"] >= r["p50_token_latency_ms"]
+        assert r["paths"].get("paged_attention") == "gather"
+        assert r["tokens"] == 3 * 8          # every budget fully served
+
+
 class TestHostIo:
     def test_hostio_smoke_reports_all_paths(self):
         """measure_hostio runs device-free and reports a rate per

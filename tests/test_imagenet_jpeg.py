@@ -213,3 +213,137 @@ class TestLabelMapAndGuards:
         tr = np.load(os.path.join(out, "train_images.npy"), mmap_mode="r")
         assert va.shape[0] > 0
         assert tr.shape[0] + va.shape[0] == 16
+
+
+class TestShardShuffle:
+    def test_train_shards_are_class_interleaved(self, tmp_path):
+        """scan_tree emits class-sorted order; the seeded global
+        permutation must interleave classes so per-device blocks and the
+        head-of-shard val carve (data/imagenet.load_splits) are
+        class-balanced."""
+        _write_tree(tmp_path, per_class=12, split_dirs=True)
+        out = imagenet_jpeg.ingest(str(tmp_path), image_size=32)
+        trl = np.load(os.path.join(out, "train_labels.npy"))
+        assert set(trl) == {0, 1}
+        # class-sorted order would put ONE class in the first half
+        half = len(trl) // 2
+        assert len(set(trl[:half].tolist())) == 2, \
+            f"first half single-class: {trl.tolist()}"
+
+    def test_shuffle_is_seeded_deterministic(self, tmp_path):
+        _write_tree(tmp_path, per_class=6, split_dirs=True)
+        a = imagenet_jpeg.ingest(str(tmp_path), str(tmp_path / "out_a"),
+                                 image_size=32)
+        b = imagenet_jpeg.ingest(str(tmp_path), str(tmp_path / "out_b"),
+                                 image_size=32)
+        np.testing.assert_array_equal(
+            np.load(os.path.join(a, "train_labels.npy")),
+            np.load(os.path.join(b, "train_labels.npy")))
+
+
+class TestCommitGuards:
+    def test_rename_failure_without_destination_reraises(self, tmp_path,
+                                                         monkeypatch):
+        """A failed final rename with NO committed destination must
+        surface, not silently fall through to synthetic data."""
+        _write_tree(tmp_path, per_class=4)
+        real_rename = os.rename
+
+        def deny(src, dst):
+            if str(dst).endswith("imagenet_npy"):
+                raise OSError("permission denied")
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(os, "rename", deny)
+        with pytest.raises(OSError, match="permission denied"):
+            imagenet_jpeg.ingest(str(tmp_path), image_size=32)
+        assert not os.path.isdir(tmp_path / "imagenet_npy")
+
+    def test_rename_loss_to_concurrent_winner_is_tolerated(self, tmp_path,
+                                                           monkeypatch):
+        _write_tree(tmp_path, per_class=4)
+        real_rename = os.rename
+
+        def racy(src, dst):
+            if str(dst).endswith("imagenet_npy"):
+                # a concurrent writer committed a complete dir first
+                os.makedirs(dst, exist_ok=True)
+                raise OSError("directory not empty")
+            return real_rename(src, dst)
+
+        monkeypatch.setattr(os, "rename", racy)
+        out = imagenet_jpeg.ingest(str(tmp_path), image_size=32)
+        assert os.path.isdir(out)
+
+
+class TestIngestFailureMarker:
+    def test_process0_failure_commits_marker(self, tmp_path, monkeypatch):
+        """When process 0's ingest dies, it must leave a failure marker
+        so waiting ranks fail fast instead of spinning for 8 hours."""
+        _write_tree(tmp_path, per_class=4)
+
+        def boom(root, out_dir=None, image_size=224, **kw):
+            raise RuntimeError("disk full")
+
+        monkeypatch.setattr(imagenet_jpeg, "ingest", boom)
+        with pytest.raises(RuntimeError, match="disk full"):
+            imagenet.load_splits(str(tmp_path), image_size=32)
+        marker = tmp_path / "imagenet_npy.failed"
+        assert marker.exists()
+        assert "disk full" in marker.read_text()
+
+    def test_waiting_rank_fails_fast_on_appearing_marker(self, tmp_path,
+                                                         monkeypatch):
+        """A marker that APPEARS while a rank waits is this cohort's
+        failure: the waiter must raise within a poll or two, not spin
+        out its 8-hour deadline."""
+        import threading
+
+        import jax
+
+        _write_tree(tmp_path, per_class=4)
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        t = threading.Timer(1.0, (tmp_path / "imagenet_npy.failed")
+                            .write_text, args=("RuntimeError: disk full",))
+        t.start()
+        try:
+            with pytest.raises(RuntimeError, match="disk full"):
+                imagenet.load_splits(str(tmp_path), image_size=32)
+        finally:
+            t.cancel()
+
+    def test_preexisting_marker_waits_for_rank0_to_clear_it(self, tmp_path,
+                                                            monkeypatch):
+        """A marker already present when the wait begins may belong to a
+        PREVIOUS run (process 0 unlinks it on startup): the waiter must
+        give rank 0 a grace window instead of dying on the first poll —
+        here the 'rank 0' clears it and commits, and the waiter serves."""
+        import threading
+
+        import jax
+
+        _write_tree(tmp_path, per_class=4)
+        out = imagenet_jpeg.ingest(str(tmp_path),
+                                   str(tmp_path / "npy_ready"),
+                                   image_size=32)
+        (tmp_path / "imagenet_npy.failed").write_text("old failure")
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+
+        def rank0_recovers():
+            (tmp_path / "imagenet_npy.failed").unlink()
+            (tmp_path / "npy_ready").rename(tmp_path / "imagenet_npy")
+
+        t = threading.Timer(1.0, rank0_recovers)
+        t.start()
+        try:
+            splits = imagenet.load_splits(str(tmp_path), image_size=32)
+        finally:
+            t.cancel()
+        assert splits.train_data.shape[1:] == (32, 32, 3)
+
+    def test_successful_reingest_clears_stale_marker(self, tmp_path):
+        _write_tree(tmp_path, per_class=4)
+        (tmp_path / "imagenet_npy.failed").write_text("old failure")
+        splits = imagenet.load_splits(str(tmp_path), image_size=32)
+        assert splits.train_data.shape[1:] == (32, 32, 3)
+        assert not (tmp_path / "imagenet_npy.failed").exists()

@@ -1,0 +1,404 @@
+"""Serving subsystem: paged KV cache + continuous-batching engine.
+
+The tier-1 anchors the ISSUE acceptance names:
+- greedy decode through the paged path is TOKEN-IDENTICAL to
+  CausalLm.generate for the same prompts (mixed lengths, chunked
+  prefill, slot recycling all active);
+- block alloc/free accounting and scheduler admit/evict invariants
+  under a scripted request trace;
+- steady-state serving performs zero recompiles after bucket warmup
+  (jit cache-size probe).
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.models import bert, gpt
+from mpi_tensorflow_tpu.serving import (BlockAllocator, PagedDecodeEngine,
+                                        Request, Scheduler, ServeConfig)
+from mpi_tensorflow_tpu.serving.paged_cache import blocks_for, init_pools
+
+TINY = dataclasses.replace(bert.BERT_TINY, ce_positions="all")
+ROPE = dataclasses.replace(TINY, pos_kind="rope")
+
+
+def _prompts(rng, n, lo=4, hi=14, vocab=None):
+    vocab = vocab or TINY.vocab_size
+    return [list(map(int, rng.integers(0, vocab, int(s))))
+            for s in rng.integers(lo, hi + 1, n)]
+
+
+def _generate_ref(model, params, prompt, n):
+    import jax.numpy as jnp
+
+    out = np.asarray(model.generate(
+        params, jnp.asarray([prompt], jnp.int32), n))
+    return list(map(int, out[0, len(prompt):]))
+
+
+# ---------------------------------------------------------------- blocks
+
+@pytest.mark.quick
+class TestBlockAllocator:
+    def test_null_block_never_handed_out(self):
+        a = BlockAllocator(8)
+        ids = a.alloc(7)
+        assert 0 not in ids and sorted(ids) == list(range(1, 8))
+
+    def test_alloc_free_roundtrip_accounting(self):
+        a = BlockAllocator(16)
+        x = a.alloc(5)
+        y = a.alloc(3)
+        assert a.num_free == 7 and a.num_used == 8
+        assert not set(x) & set(y)
+        a.free(x)
+        assert a.num_free == 12 and a.num_used == 3
+        a.check()
+
+    def test_exhaustion_raises_and_leaves_state_clean(self):
+        a = BlockAllocator(4)
+        a.alloc(3)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            a.alloc(1)
+        a.check()
+        assert a.num_free == 0 and a.num_used == 3
+
+    def test_double_free_raises(self):
+        a = BlockAllocator(4)
+        ids = a.alloc(2)
+        a.free(ids)
+        with pytest.raises(ValueError, match="double free"):
+            a.free([ids[0]])
+
+    def test_randomized_trace_preserves_partition(self):
+        rng = np.random.default_rng(0)
+        a = BlockAllocator(32)
+        held = []
+        for _ in range(200):
+            if held and rng.random() < 0.45:
+                held.remove(grp := held[rng.integers(len(held))])
+                a.free(grp)
+            else:
+                n = int(rng.integers(1, 5))
+                if a.can_alloc(n):
+                    held.append(a.alloc(n))
+            a.check()
+        flat = [b for grp in held for b in grp]
+        assert len(flat) == len(set(flat)) == a.num_used
+
+
+# ------------------------------------------------------------- scheduler
+
+@pytest.mark.quick
+class TestScheduler:
+    def _mk(self, blocks=16, slots=2, bs=4, nb_per_seq=4):
+        return Scheduler(BlockAllocator(blocks), slots, bs, nb_per_seq)
+
+    def test_admit_needs_slot_and_blocks(self):
+        s = self._mk(blocks=5, slots=2, bs=4)   # 4 usable blocks
+        s.submit(Request(0, [1] * 8, 4))        # needs 3 blocks (9 toks)
+        s.submit(Request(1, [1] * 8, 4))
+        assert s.admit() == [0]                 # second: 3 > 1 free
+        assert [r.id for r in s.waiting] == [1]
+        s.allocator.check()
+
+    def test_fifo_head_of_line_no_queue_jumping(self):
+        s = self._mk(blocks=5, slots=2, bs=4)
+        s.submit(Request(0, [1] * 12, 4))       # needs 4 blocks
+        s.submit(Request(1, [1] * 2, 1))        # would fit, must wait
+        s.allocator.alloc(2)                    # drain pool to 2 free
+        assert s.admit() == []
+        assert [r.id for r in s.waiting] == [0, 1]
+
+    def test_budget_exhaustion_recycles_slot_and_blocks(self):
+        s = self._mk()
+        s.submit(Request(0, [1, 2, 3], 2))
+        slot = s.admit()[0]
+        s.slots[slot].prefilled = 3
+        s.record_token(slot, 7)
+        assert s.slots[slot] is not None
+        s.record_token(slot, 8)
+        assert s.slots[slot] is None
+        assert s.allocator.num_used == 0
+        assert s.finished[0].generated == [7, 8]
+
+    def test_eos_recycles_slot(self):
+        s = self._mk()
+        s.submit(Request(0, [1, 2], 10))
+        slot = s.admit()[0]
+        s.slots[slot].prefilled = 2
+        s.record_token(slot, 5, eos_id=99)
+        assert s.slots[slot] is not None
+        s.record_token(slot, 99, eos_id=99)
+        assert s.slots[slot] is None and s.allocator.num_used == 0
+
+    def test_eviction_frees_blocks_and_requeues_at_head(self):
+        s = self._mk(blocks=7, slots=2, bs=4, nb_per_seq=4)  # 6 usable
+        s.submit(Request(0, [1] * 7, 8, arrival=0.0))  # 2 blocks (8 cap)
+        s.submit(Request(1, [1] * 7, 8, arrival=1.0))
+        assert len(s.admit()) == 2
+        for slot in (0, 1):
+            s.slots[slot].prefilled = 7
+        s.record_token(0, 3)                 # length 8: fits its blocks
+        s.record_token(0, 4)                 # length 9: needs a 3rd
+        s.allocator.alloc(2)                 # external pressure: 0 free
+        assert s.ensure_block(0)             # -> evicts the YOUNGER seq
+        assert s.slots[1] is None
+        assert s.waiting[0].id == 1          # requeued at the HEAD
+        assert s.evictions == 1
+        s.allocator.check()
+
+    def test_over_capacity_request_rejected(self):
+        s = self._mk(bs=4, nb_per_seq=2)     # cap 8 tokens
+        with pytest.raises(ValueError, match="exceeds"):
+            s.submit(Request(0, [1] * 6, 4))
+
+    def test_scripted_trace_invariants(self):
+        """Admit/decode/finish churn: at every step the pool partitions
+        into free + exactly-the-live-sequences' blocks."""
+        rng = np.random.default_rng(1)
+        s = self._mk(blocks=12, slots=3, bs=2, nb_per_seq=6)
+        nxt = 0
+        for step in range(300):
+            if rng.random() < 0.3:
+                s.submit(Request(nxt, [1] * int(rng.integers(1, 8)),
+                                 int(rng.integers(1, 6)),
+                                 arrival=float(step)))
+                nxt += 1
+            for slot in s.admit():
+                s.slots[slot].prefilled = len(s.slots[slot].request.prompt)
+            for slot in list(s.live_slots()):
+                if s.slots[slot] is None:
+                    continue
+                assert s.ensure_block(slot)
+                if s.slots[slot] is None:
+                    continue
+                s.record_token(slot, int(rng.integers(0, 50)))
+            s.allocator.check()
+            live_blocks = [b for seq in s.slots if seq is not None
+                           for b in seq.block_ids]
+            assert len(live_blocks) == len(set(live_blocks))
+            assert len(live_blocks) == s.allocator.num_used
+        assert s.finished                     # the trace actually served
+
+
+# ------------------------------------------------- paged forward parity
+
+class TestPagedForwardParity:
+    @pytest.mark.parametrize("cfg", [TINY, ROPE], ids=["learned", "rope"])
+    def test_prefill_logits_match_contiguous_cache(self, cfg):
+        """Same prompt, same capacity: the paged forward must reproduce
+        forward_with_cache's logits (same shared-layer math over a
+        position-ordered cache view)."""
+        import jax
+        import jax.numpy as jnp
+
+        model = gpt.CausalLm(cfg)
+        params = model.init(jax.random.key(0))
+        toks = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, (2, 10)), jnp.int32)
+        bs, nb = 4, 3                        # capacity 12 both paths
+        want, _ = model.forward_with_cache(
+            params, toks, model.init_cache(2, nb * bs), 0)
+        pools = init_pools(cfg, 1 + 2 * nb, bs)
+        tables = jnp.asarray([[1, 2, 3], [4, 5, 6]], jnp.int32)
+        got, new_pools = model.forward_paged(
+            params, toks, pools, tables, jnp.zeros((2,), jnp.int32))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("cfg", [TINY, ROPE], ids=["learned", "rope"])
+    def test_greedy_decode_token_identical_to_generate(self, cfg):
+        """THE acceptance pin: mixed prompt/output lengths served through
+        chunked prefill + continuous batching emit exactly the tokens
+        generate() produces per request."""
+        import jax
+
+        model = gpt.CausalLm(cfg)
+        params = model.init(jax.random.key(1))
+        rng = np.random.default_rng(2)
+        prompts = _prompts(rng, 5, lo=3, hi=13, vocab=cfg.vocab_size)
+        budgets = [int(n) for n in rng.integers(1, 9, len(prompts))]
+        engine = PagedDecodeEngine(model, params, ServeConfig(
+            num_blocks=40, block_size=4, max_slots=3, max_seq_len=24,
+            prefill_chunk=8))
+        res = engine.run([Request(i, p, n) for i, (p, n)
+                          in enumerate(zip(prompts, budgets))])
+        for i, (p, n) in enumerate(zip(prompts, budgets)):
+            assert res["outputs"][i] == _generate_ref(model, params, p, n), \
+                f"request {i} diverged from generate()"
+        engine.allocator.check()
+        assert engine.allocator.num_used == 0
+
+
+# ------------------------------------------------------------ the engine
+
+class TestEngine:
+    def _engine(self, **kw):
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = ServeConfig(**{**dict(num_blocks=40, block_size=4,
+                                      max_slots=4, max_seq_len=32,
+                                      prefill_chunk=8), **kw})
+        return model, params, PagedDecodeEngine(model, params, serve)
+
+    def test_zero_recompiles_after_bucket_warmup(self):
+        """Warm the buckets on one trace, then serve a DIFFERENT trace in
+        the same envelope: the jit caches must not grow — steady-state
+        serving never recompiles."""
+        _, _, engine = self._engine()
+        shape_rng = np.random.default_rng(3)
+        lens = shape_rng.integers(3, 16, 6)
+        budgets = [int(n) for n in shape_rng.integers(1, 10, 6)]
+
+        def trace(content_seed):
+            r = np.random.default_rng(content_seed)
+            return [Request(i, list(map(int, r.integers(
+                        0, TINY.vocab_size, int(s)))), budgets[i])
+                    for i, s in enumerate(lens)]
+
+        engine.run(trace(0))
+        warm = engine.compile_counts()
+        assert warm["decode"] > 0 and warm["prefill"] > 0
+        engine.reset()
+        engine.run(trace(7))                  # new content, same envelope
+        assert engine.compile_counts() == warm, \
+            "steady-state serving recompiled"
+
+    def test_dispatch_shapes_are_bucketed_powers_of_two(self):
+        _, _, engine = self._engine()
+        rng = np.random.default_rng(4)
+        reqs = [Request(i, p, int(rng.integers(1, 8)))
+                for i, p in enumerate(_prompts(rng, 7, lo=3, hi=15))]
+        engine.run(reqs)
+        for shape in engine.dispatch_shapes:
+            for dim in shape[1:]:
+                assert dim & (dim - 1) == 0, f"non-pow2 dispatch {shape}"
+
+    def test_more_requests_than_slots_all_complete(self):
+        _, _, engine = self._engine(max_slots=2)
+        rng = np.random.default_rng(5)
+        budgets = [int(n) for n in rng.integers(1, 7, 6)]
+        reqs = [Request(i, p, budgets[i])
+                for i, p in enumerate(_prompts(rng, 6, lo=3, hi=10))]
+        res = engine.run(reqs)
+        assert sorted(res["outputs"]) == list(range(6))
+        for i, n in enumerate(budgets):
+            assert len(res["outputs"][i]) == n
+        assert engine.allocator.num_used == 0
+
+    def test_eos_recycles_midstream(self):
+        model, params, engine = self._engine()
+        probe = engine.run([Request(0, [5, 6, 7], 6)])
+        full = probe["outputs"][0]
+        assert len(full) == 6
+        eos = full[2]
+        _, _, engine2 = self._engine(eos_id=eos)
+        res = engine2.run([Request(0, [5, 6, 7], 6)])
+        # greedy is deterministic: engine2 emits full's tokens until the
+        # FIRST occurrence of the eos value, then recycles the slot
+        assert res["outputs"][0] == full[:full.index(eos) + 1]
+        assert engine2.allocator.num_used == 0
+
+    def test_memory_scales_with_live_tokens_not_batch_times_maxlen(self):
+        """The paged pool serves a workload whose static contiguous cache
+        would need more memory: 4 slots x 32 max_len = 128 entries
+        contiguous vs a 23-usable-block (92-entry) pool."""
+        _, _, engine = self._engine(num_blocks=24)   # 23 usable = 92 toks
+        rng = np.random.default_rng(6)
+        reqs = [Request(i, p, 4)
+                for i, p in enumerate(_prompts(rng, 8, lo=3, hi=10))]
+        res = engine.run(reqs)
+        assert sorted(res["outputs"]) == list(range(8))
+
+    def test_eviction_under_pool_pressure_keeps_outputs_exact(self):
+        """A tight pool forces the youngest sequence out mid-prefill
+        (restart-from-scratch preemption); the evicted request must
+        still complete with generate()-identical tokens, and a stale
+        prefill-queue entry must never prefill the slot's NEW occupant."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = ServeConfig(num_blocks=9, block_size=2, max_slots=2,
+                            max_seq_len=12, prefill_chunk=2)
+        engine = PagedDecodeEngine(model, params, serve)
+        rng = np.random.default_rng(8)
+        pa = list(map(int, rng.integers(0, TINY.vocab_size, 2)))
+        pb = list(map(int, rng.integers(0, TINY.vocab_size, 11)))
+        res = engine.run([Request(0, pa, 10, arrival=0.0),
+                          Request(1, pb, 1, arrival=0.0)])
+        assert engine.sched.evictions >= 1, \
+            "trace was meant to exercise eviction"
+        assert res["outputs"][0] == _generate_ref(model, params, pa, 10)
+        assert res["outputs"][1] == _generate_ref(model, params, pb, 1)
+        engine.allocator.check()
+        assert engine.allocator.num_used == 0
+
+    def test_arrival_stamps_gate_admission(self):
+        """A request with a later arrival must not be admitted before its
+        stamp on the engine's clock — the run must outlast the stamp."""
+        _, _, engine = self._engine()
+        clock = {"t": 0.0}
+
+        def fake_time():
+            clock["t"] += 0.01
+            return clock["t"]
+
+        res = engine.run([Request(0, [1, 2, 3], 2, arrival=0.0),
+                          Request(1, [4, 5], 2, arrival=0.5)],
+                         time_fn=fake_time)
+        assert sorted(res["outputs"]) == [0, 1]
+        assert clock["t"] > 0.5
+
+
+# ------------------------------------------------------------ cli guards
+
+@pytest.mark.quick
+class TestServeCliGuards:
+    def test_virtual_stages_requires_interleaved_schedule(self):
+        from mpi_tensorflow_tpu import cli
+
+        with pytest.raises(SystemExit, match="virtual-stages"):
+            cli.main(["--virtual-stages", "3"])
+
+    def test_virtual_stages_accepted_with_interleaved(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(
+            ["--virtual-stages", "3", "--pp-schedule", "1f1b_interleaved"])
+        assert cli.config_from_args(args).virtual_stages == 3
+
+    def test_bad_serve_geometry_rejected(self):
+        from mpi_tensorflow_tpu import cli
+
+        with pytest.raises(SystemExit, match="serve"):
+            cli.main(["--serve-block-size", "0"])
+
+    def test_serve_knobs_reach_config(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(
+            ["--serve-pool-blocks", "64", "--serve-block-size", "8",
+             "--serve-max-slots", "4", "--serve-max-seq-len", "256"])
+        c = cli.config_from_args(args)
+        assert (c.serve_pool_blocks, c.serve_block_size,
+                c.serve_max_slots, c.serve_max_seq_len) == (64, 8, 4, 256)
+
+    def test_serve_config_bridges_from_run_config(self):
+        """Config.serve_* knobs are consumed through ServeConfig.
+        from_config — the knobs must not be parse-only decoration."""
+        from mpi_tensorflow_tpu.config import Config
+
+        c = Config(serve_pool_blocks=64, serve_block_size=8,
+                   serve_max_slots=4, serve_max_seq_len=256)
+        s = ServeConfig.from_config(c)
+        assert (s.num_blocks, s.block_size, s.max_slots,
+                s.max_seq_len) == (64, 8, 4, 256)
+        # explicit overrides win; None means "use the Config value"
+        s2 = ServeConfig.from_config(c, max_slots=2, block_size=None)
+        assert s2.max_slots == 2 and s2.block_size == 8
