@@ -403,7 +403,11 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     pool_blocks: int | None = None,
                     block_size: int | None = None, prompt_max: int = 32,
                     output_max: int = 128, precision: str = "bf16",
-                    seed: int = 0) -> dict:
+                    seed: int = 0, deadline_ms: float | None = None,
+                    queue_depth: int | None = None,
+                    max_evictions: int | None = None,
+                    drain_ms: float | None = None,
+                    journal: str | None = None, tiny: bool = False) -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic Poisson request trace.
 
@@ -421,9 +425,22 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     (batches start as if all members were already present) — a bias IN
     THE BASELINE'S FAVOR; continuous batching must beat it anyway.
     Tokens counted are the REQUESTED output tokens for both arms.
+
+    Fault tolerance: ``deadline_ms/queue_depth/max_evictions/drain_ms``
+    are the admission-control and drain knobs (serving ServeConfig; the
+    emitted detail carries the ``faults`` health-counter block either
+    way).  A ``journal`` path switches to the FAULT-TOLERANT SERVE mode:
+    no warmup replay and no static arm (both would double-journal the
+    trace) — one journaled run through the crash-recovery supervisor
+    (serving/recovery.run_with_replay) with SIGTERM wired to graceful
+    drain, emitting per-request outputs + terminal statuses so a
+    relaunch after SIGKILL provably resumes token-identically.  ``tiny``
+    swaps BERT_TINY geometry in for the model — the smoke/CI
+    configuration the fault-injection subprocess tests run.
     """
     import dataclasses as dc
     import time
+    from collections import Counter
 
     import jax
     import jax.numpy as jnp
@@ -448,7 +465,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     max_slots = max_slots if max_slots is not None else cfg.serve_max_slots
     block_size = (block_size if block_size is not None
                   else cfg.serve_block_size)
-    bcfg = dc.replace(bert.BERT_BASE, dtype=cfg.compute_dtype)
+    bcfg = dc.replace(bert.BERT_TINY if tiny else bert.BERT_BASE,
+                      dtype=cfg.compute_dtype)
     model = gpt.CausalLm(bcfg)
     params = model.init(jax.random.key(0))
     rng = np.random.default_rng(seed)
@@ -468,18 +486,64 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         pool_blocks = max_slots * bps + 1
     serve = ServeConfig.from_config(
         cfg, num_blocks=pool_blocks, block_size=block_size,
-        max_slots=max_slots, max_seq_len=max_seq_len)
-    engine = PagedDecodeEngine(model, params, serve)
+        max_slots=max_slots, max_seq_len=max_seq_len,
+        deadline_ms=deadline_ms, queue_depth=queue_depth,
+        max_evictions=max_evictions, drain_ms=drain_ms)
 
     def trace():
         return [Request(i, prompts[i], outputs[i], float(arrivals[i]))
                 for i in range(num_requests)]
 
+    from mpi_tensorflow_tpu.train.preemption import PreemptionGuard
+
+    if journal is not None:
+        # fault-tolerant serve mode: one journaled pass through the
+        # crash-recovery supervisor; a SIGKILLed run relaunched with the
+        # same --serve-journal resumes from the journal and the merged
+        # outputs are token-identical to an unfaulted run
+        from mpi_tensorflow_tpu.serving import recovery
+
+        engagement.reset()
+        with PreemptionGuard.installed() as guard:
+            res = recovery.run_with_replay(
+                lambda: PagedDecodeEngine(model, params, serve),
+                trace(), journal_path=journal, guard=guard)
+        return {
+            "model": "gpt_tiny" if tiny else "gpt_base",
+            "serving_tokens_per_sec": res["tokens_per_sec"],
+            "p50_token_latency_ms": res["p50_token_latency_ms"],
+            "p99_token_latency_ms": res["p99_token_latency_ms"],
+            "static_batch_tokens_per_sec": None,
+            "speedup_vs_static": None,
+            "tokens": res["tokens"],              # the final attempt's own
+            "delivered_tokens": res["delivered_tokens"],  # journal-merged
+            "elapsed_s": res["elapsed_s"],
+            "evictions": res["evictions"],
+            "outputs": res["outputs"],
+            "statuses": res["statuses"],
+            "status_counts": dict(Counter(res["statuses"].values())),
+            "faults": res["faults"],
+            "drain": res["drain"],
+            "replays": res["replays"],
+            "journal": journal,
+            "paths": engagement.snapshot(),
+            "num_requests": num_requests, "rate_rps": rate_rps,
+            "max_slots": max_slots, "pool_blocks": pool_blocks,
+            "block_size": block_size, "prompt_max": prompt_max,
+            "output_max": output_max, "max_seq_len": max_seq_len,
+            "deadline_ms": deadline_ms, "queue_depth": queue_depth,
+            "max_evictions": max_evictions, "drain_ms": drain_ms,
+            "tiny": tiny, "precision": precision,
+            "platform": jax.devices()[0].platform,
+        }
+
+    engine = PagedDecodeEngine(model, params, serve)
     engagement.reset()
     engine.run(trace())                       # warmup: pays the compiles
     warm_compiles = engine.compile_counts()
     engine.reset()
-    cb = engine.run(trace())
+    with PreemptionGuard.installed() as guard:
+        cb = engine.run(trace(), guard=guard)
     steady_compiles = engine.compile_counts()
 
     # -- static-batch baseline: generate() on arrival-order groups of
@@ -516,7 +580,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     static_tps = useful / static_sec if static_sec > 0 else 0.0
 
     return {
-        "model": "gpt_base",
+        "model": "gpt_tiny" if tiny else "gpt_base",
         "serving_tokens_per_sec": cb["tokens_per_sec"],
         "p50_token_latency_ms": cb["p50_token_latency_ms"],
         "p99_token_latency_ms": cb["p99_token_latency_ms"],
@@ -526,6 +590,14 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "tokens": cb["tokens"],
         "elapsed_s": cb["elapsed_s"],
         "evictions": cb["evictions"],
+        # serving health counters (admission control / drain outcomes):
+        # the canonical faults block, zero-valued on a clean run
+        "faults": cb["faults"],
+        "status_counts": dict(Counter(cb["statuses"].values())),
+        "drain": cb["drain"],
+        "deadline_ms": deadline_ms, "queue_depth": queue_depth,
+        "max_evictions": max_evictions, "drain_ms": drain_ms,
+        "tiny": tiny,
         "dispatch_shapes": [list(s) for s in cb["dispatch_shapes"]],
         "compiles_after_warmup": warm_compiles,
         "compiles_after_steady": steady_compiles,
@@ -810,6 +882,23 @@ def _stale_score(args, d: dict, item=None):
 
         serve_defaults = Config()     # unset knobs resolve through here,
                                       # exactly as measure_serving does
+        if getattr(args, "serve_journal", None) or d.get("journal") or \
+                getattr(args, "serve_tiny", False) or d.get("tiny"):
+            # a journaled serve is a serve, not a measurement (no warmup
+            # replay, no static arm — compile time pollutes its rate);
+            # tiny geometry is a smoke config.  Neither a journaled
+            # REQUEST nor a journaled RECORD may stand in
+            return None
+        # the fault-policy knobs shape the trace outcome (expiries,
+        # sheds): a record measured under a different policy is a
+        # different number (absent keys on old records read as the
+        # None/off defaults they were measured with)
+        for k, attr in (("deadline_ms", "serve_deadline_ms"),
+                        ("queue_depth", "serve_queue_depth"),
+                        ("max_evictions", "serve_max_evictions"),
+                        ("drain_ms", "serve_drain_ms")):
+            if d.get(k) != getattr(args, attr, None):
+                return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1086,6 +1175,32 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-block-size", type=int, default=None,
                     help="serving mode: cache entries per pool block "
                          "(default: the run Config's serve_block_size)")
+    ap.add_argument("--serve-deadline-ms", type=float, default=None,
+                    help="serving mode: per-request TTL from arrival; "
+                         "expired work fails with deadline_exceeded "
+                         "(default: no deadline)")
+    ap.add_argument("--serve-queue-depth", type=int, default=None,
+                    help="serving mode: waiting-queue bound; a full "
+                         "queue load-sheds the newest submit (default: "
+                         "unbounded)")
+    ap.add_argument("--serve-max-evictions", type=int, default=None,
+                    help="serving mode: evictions allowed per request "
+                         "before it fails with evicted_too_often "
+                         "(default: unbounded)")
+    ap.add_argument("--serve-drain-ms", type=float, default=None,
+                    help="serving mode: graceful-drain budget after "
+                         "SIGTERM (default: finish all in-flight work)")
+    ap.add_argument("--serve-journal", default=None,
+                    help="serving mode: fault-tolerant serve — journal "
+                         "each request's prompt + generated prefix here "
+                         "and, when the file already exists (a prior "
+                         "run crashed), resume by replaying live "
+                         "sequences token-identically.  Skips the "
+                         "warmup replay and the static-batch arm")
+    ap.add_argument("--serve-tiny", action="store_true",
+                    help="serving mode: BERT_TINY model geometry — the "
+                         "smoke/fault-injection configuration, not a "
+                         "benchmark number")
     ap.add_argument("--prompt-len", type=int, default=32,
                     help="decode mode: prompt length")
     ap.add_argument("--new-tokens", type=int, default=128,
@@ -1225,7 +1340,13 @@ def main(argv=None) -> int:
                             block_size=args.serve_block_size,
                             prompt_max=args.prompt_len,
                             output_max=args.new_tokens,
-                            precision=args.precision)
+                            precision=args.precision,
+                            deadline_ms=args.serve_deadline_ms,
+                            queue_depth=args.serve_queue_depth,
+                            max_evictions=args.serve_max_evictions,
+                            drain_ms=args.serve_drain_ms,
+                            journal=args.serve_journal,
+                            tiny=args.serve_tiny)
         return _report(args, r)
 
     if args.mode == "decode":

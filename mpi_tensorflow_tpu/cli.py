@@ -141,6 +141,29 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.serve_max_seq_len,
                    help="serving: per-request prompt+output cap (sizes "
                         "the per-sequence block table)")
+    p.add_argument("--serve-deadline-ms", type=float,
+                   default=d.serve_deadline_ms,
+                   help="serving: default per-request TTL from arrival; "
+                        "work not complete by then fails with "
+                        "deadline_exceeded instead of occupying a slot "
+                        "(default: no deadline)")
+    p.add_argument("--serve-queue-depth", type=int,
+                   default=d.serve_queue_depth,
+                   help="serving: bound on the waiting queue; a full "
+                        "queue load-sheds the newest submit with a "
+                        "queue_full reason (default: unbounded)")
+    p.add_argument("--serve-max-evictions", type=int,
+                   default=d.serve_max_evictions,
+                   help="serving: a request preempted more than this "
+                        "many times fails with evicted_too_often "
+                        "instead of requeueing forever (default: "
+                        "unbounded)")
+    p.add_argument("--serve-drain-ms", type=float,
+                   default=d.serve_drain_ms,
+                   help="serving: graceful-drain budget after SIGTERM — "
+                        "in-flight sequences finish inside it, the rest "
+                        "terminate with status `drained` (default: "
+                        "finish all in-flight work)")
     p.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
                    default=d.prng_impl,
                    help="dropout-mask PRNG: threefry (JAX default, "
@@ -183,6 +206,10 @@ def config_from_args(args) -> Config:
         serve_block_size=args.serve_block_size,
         serve_max_slots=args.serve_max_slots,
         serve_max_seq_len=args.serve_max_seq_len,
+        serve_deadline_ms=args.serve_deadline_ms,
+        serve_queue_depth=args.serve_queue_depth,
+        serve_max_evictions=args.serve_max_evictions,
+        serve_drain_ms=args.serve_drain_ms,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
@@ -232,6 +259,20 @@ def main(argv=None) -> int:
             f"block-size {config.serve_block_size} (>= 1), max-slots "
             f"{config.serve_max_slots} (>= 1), max-seq-len "
             f"{config.serve_max_seq_len} (>= 1)")
+    if (config.serve_deadline_ms is not None
+            and config.serve_deadline_ms <= 0) \
+            or (config.serve_queue_depth is not None
+                and config.serve_queue_depth < 1) \
+            or (config.serve_max_evictions is not None
+                and config.serve_max_evictions < 1) \
+            or (config.serve_drain_ms is not None
+                and config.serve_drain_ms < 0):
+        raise SystemExit(
+            f"bad --serve-* fault policy: deadline-ms "
+            f"{config.serve_deadline_ms} (> 0), queue-depth "
+            f"{config.serve_queue_depth} (>= 1), max-evictions "
+            f"{config.serve_max_evictions} (>= 1), drain-ms "
+            f"{config.serve_drain_ms} (>= 0)")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

@@ -105,6 +105,22 @@ class Config:
     serve_max_slots: int = 8      # concurrent sequences (decode batch cap)
     serve_max_seq_len: int = 512  # per-request prompt+output cap; also
                                   # sizes the per-sequence block table
+    # fault-tolerance policy (serving/engine.ServeConfig; None = off)
+    serve_deadline_ms: Optional[float] = None  # default per-request TTL
+                                  # from arrival; expired work fails
+                                  # with deadline_exceeded instead of
+                                  # occupying a slot
+    serve_queue_depth: Optional[int] = None    # bound on the waiting
+                                  # queue; a full queue load-sheds the
+                                  # newest submit (backpressure)
+    serve_max_evictions: Optional[int] = None  # preemption-livelock
+                                  # guard: a request evicted more than
+                                  # this many times fails with
+                                  # evicted_too_often
+    serve_drain_ms: Optional[float] = None     # graceful-drain budget
+                                  # after SIGTERM; in-flight work past
+                                  # it is cut with status `drained`
+                                  # (None = finish all in-flight)
 
     # --- checkpointing (absent from the reference; SURVEY.md §5) ---
     checkpoint_dir: Optional[str] = None   # None = checkpointing off

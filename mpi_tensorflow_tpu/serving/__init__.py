@@ -1,17 +1,26 @@
 """Continuous-batching serving subsystem (Orca / vLLM lineage).
 
-Three cooperating layers, host-side policy over device-side math:
+Four cooperating layers, host-side policy over device-side math:
 
 - ``paged_cache``  — fixed device pool of KV blocks + the host block
                      allocator; memory scales with LIVE tokens, not
                      ``batch x max_len`` (vs models/gpt.init_cache).
 - ``scheduler``    — request queue, admit-on-free-blocks, per-step slot
-                     recycling on EOS/budget, eviction under pressure.
+                     recycling on EOS/budget, eviction under pressure;
+                     admission control (feasibility check, bounded
+                     queue, deadlines), livelock/starvation guards, and
+                     a structured terminal status for every request.
 - ``engine``       — chunked prefill + single-token decode steps at a
                      small fixed set of bucketed shapes (powers of two),
                      with the block pool donated through every dispatch
                      so steady-state serving updates the cache in place
-                     and never recompiles after bucket warmup.
+                     and never recompiles after bucket warmup; graceful
+                     SIGTERM drain via train/preemption.PreemptionGuard.
+- ``recovery``     — host-side replay journal (prompt + generated
+                     prefix per request) and the transient-failure
+                     supervisor: rebuild pools/engine on device loss and
+                     replay live sequences token-identically (greedy
+                     decode is deterministic).
 
 The decode math itself lives in models/gpt.CausalLm.forward_paged (the
 shared transformer stack) and ops/paged_attention (gather/scatter).
@@ -21,5 +30,7 @@ from mpi_tensorflow_tpu.serving.engine import (  # noqa: F401
     PagedDecodeEngine, ServeConfig)
 from mpi_tensorflow_tpu.serving.paged_cache import (  # noqa: F401
     BlockAllocator, init_pools)
+from mpi_tensorflow_tpu.serving.recovery import (  # noqa: F401
+    ReplayJournal, run_with_replay)
 from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401
-    Request, Scheduler)
+    Request, RejectedRequest, Scheduler, TERMINAL_STATUSES)

@@ -34,7 +34,8 @@ from mpi_tensorflow_tpu.serving import paged_cache, scheduler as sched_lib
 
 @dataclasses.dataclass(frozen=True)
 class ServeConfig:
-    """Serving-pool geometry (the --serve-* CLI knobs)."""
+    """Serving-pool geometry + fault-tolerance policy (the --serve-*
+    CLI knobs)."""
     num_blocks: int = 128         # pool blocks, block 0 reserved as null
     block_size: int = 16          # cache entries per block
     max_slots: int = 8            # concurrent sequences (decode batch cap)
@@ -43,6 +44,22 @@ class ServeConfig:
     eos_id: Optional[int] = None  # emit-EOS slot recycling (None: budget
                                   # exhaustion only — the LM families
                                   # train on streams with no terminator)
+    # --- fault-tolerance policy (None = feature off / unbounded) ---
+    deadline_ms: Optional[float] = None   # default per-request TTL from
+                                  # arrival; expired work fails with
+                                  # deadline_exceeded instead of
+                                  # occupying slots (an explicit
+                                  # Request.deadline wins)
+    queue_depth: Optional[int] = None     # bound on the waiting queue;
+                                  # a submit finding it full is load-
+                                  # shed (reject-newest, queue_full)
+    max_evictions: Optional[int] = None   # preemption-livelock guard: a
+                                  # request evicted more than this many
+                                  # times fails with evicted_too_often
+    drain_ms: Optional[float] = None      # graceful-drain budget after a
+                                  # stop request (SIGTERM): in-flight
+                                  # work past it is cut with status
+                                  # `drained` (None = finish in flight)
 
     @classmethod
     def from_config(cls, config, **overrides):
@@ -53,7 +70,11 @@ class ServeConfig:
         base = dict(num_blocks=config.serve_pool_blocks,
                     block_size=config.serve_block_size,
                     max_slots=config.serve_max_slots,
-                    max_seq_len=config.serve_max_seq_len)
+                    max_seq_len=config.serve_max_seq_len,
+                    deadline_ms=config.serve_deadline_ms,
+                    queue_depth=config.serve_queue_depth,
+                    max_evictions=config.serve_max_evictions,
+                    drain_ms=config.serve_drain_ms)
         base.update({k: v for k, v in overrides.items() if v is not None})
         return cls(**base)
 
@@ -66,6 +87,12 @@ class ServeConfig:
                 or self.prefill_chunk < 1 or self.max_slots < 1 \
                 or self.max_seq_len < 1:
             raise ValueError(f"bad pool geometry: {self}")
+        if (self.deadline_ms is not None and self.deadline_ms <= 0) \
+                or (self.queue_depth is not None and self.queue_depth < 1) \
+                or (self.max_evictions is not None
+                    and self.max_evictions < 1) \
+                or (self.drain_ms is not None and self.drain_ms < 0):
+            raise ValueError(f"bad fault-tolerance policy: {self}")
         if self.num_blocks - 1 < self.max_blocks_per_seq:
             # a lone max-length sequence must fit, or the scheduler can
             # deadlock with nothing left to evict
@@ -129,7 +156,16 @@ class PagedDecodeEngine:
         self.allocator = paged_cache.BlockAllocator(self.serve.num_blocks)
         self.sched = sched_lib.Scheduler(
             self.allocator, self.serve.max_slots, self.serve.block_size,
-            self.serve.max_blocks_per_seq)
+            self.serve.max_blocks_per_seq,
+            queue_depth=self.serve.queue_depth,
+            max_evictions=self.serve.max_evictions)
+        self._progressed = False        # did the last step() do any work
+        self._journal = None            # set by run(); step() journals a
+                                        # token BEFORE record_token so the
+                                        # durable order is always tok-then-
+                                        # end (an end-ok preceding its own
+                                        # finishing token would replay a
+                                        # truncated stream as complete)
         self._last_token: dict = {}     # slot -> next token to feed
         # admitted (slot, Sequence) pairs awaiting prefill: the sequence
         # identity guards against a slot being evicted and re-admitted
@@ -194,6 +230,7 @@ class PagedDecodeEngine:
         else:
             return []
         prompt = seq.request.prompt
+        self._progressed = True          # a chunk enters the pool
         chunk = prompt[seq.prefilled:seq.prefilled + self.serve.prefill_chunk]
         sb = _bucket(len(chunk), self.serve.prefill_chunk)
         toks = np.zeros((1, sb), np.int32)
@@ -213,6 +250,8 @@ class PagedDecodeEngine:
         # enters the decode pool one token ahead
         tok = int(nxt)
         self._last_token[slot] = tok
+        if self._journal is not None:
+            self._journal.record_token(seq.request.id, tok)
         self.sched.record_token(slot, tok, self.serve.eos_id)
         return [(seq.request.id, tok)]
 
@@ -222,8 +261,12 @@ class PagedDecodeEngine:
         emitted."""
         import jax.numpy as jnp
 
+        self._progressed = False
+        admitted = self.sched.admit()
+        if admitted:
+            self._progressed = True
         self._prefill_queue.extend(
-            (slot, self.sched.slots[slot]) for slot in self.sched.admit())
+            (slot, self.sched.slots[slot]) for slot in admitted)
         emitted = self._advance_prefill()
 
         live = []
@@ -232,13 +275,20 @@ class PagedDecodeEngine:
             if seq is None or seq.prefilled < len(seq.request.prompt):
                 continue            # mid-prefill: not in the decode pool
             if not self.sched.ensure_block(slot):
-                raise RuntimeError(
-                    "block pool exhausted with nothing left to evict")
+                # pool exhausted with nothing left to evict: THIS request
+                # cannot grow — fail it alone (blocks freed, terminal
+                # status recorded); every other in-flight stream keeps
+                # serving.  Unreachable when submit()'s feasibility check
+                # gates admission, kept as defense in depth: one request
+                # must never take the engine down.
+                self.sched.fail_live(slot, "rejected")
+                continue
             live.append(slot)
         # eviction inside ensure_block may have retired a later slot
         live = [s for s in live if self.sched.slots[s] is not None]
         if not live:
             return emitted
+        self._progressed = True
 
         Bb = _bucket(len(live), self.serve.max_slots)
         nb = max(len(self.sched.slots[s].block_ids) for s in live)
@@ -261,56 +311,134 @@ class PagedDecodeEngine:
         for j, slot in enumerate(live):
             tok = int(nxt[j])
             self._last_token[slot] = tok
-            emitted.append((self.sched.slots[slot].request.id, tok))
+            rid = self.sched.slots[slot].request.id
+            emitted.append((rid, tok))
+            if self._journal is not None:
+                self._journal.record_token(rid, tok)
             self.sched.record_token(slot, tok, self.serve.eos_id)
         return emitted
 
     # ---------------- request loop ----------------
 
     def run(self, requests: List[sched_lib.Request],
-            time_fn=time.perf_counter) -> dict:
+            time_fn=time.perf_counter, *, guard=None, journal=None) -> dict:
         """Serve ``requests`` (replayed against their ``arrival`` stamps)
-        to completion.  The per-token latency of a token is the wall
-        time since the previous token of the SAME sequence (first token:
-        since arrival, queueing included) — the stream cadence a client
-        sees.  An evicted request's pre-eviction tokens are discarded
-        from the latency sample (they are regenerated; only the final
-        delivered stream counts), with its clock restarted at eviction."""
+        to completion or graceful drain.  The per-token latency of a
+        token is the wall time since the previous token of the SAME
+        sequence (first token: since arrival, queueing included) — the
+        stream cadence a client sees.  An evicted request's pre-eviction
+        tokens are discarded from the latency sample (they are
+        regenerated; only the final delivered stream counts), with its
+        clock restarted at eviction.
+
+        ``guard`` (train/preemption.PreemptionGuard or anything with a
+        ``should_stop`` flag) wires SIGTERM into a graceful drain:
+        admission stops (un-admitted work is ``shed``), in-flight
+        sequences finish within ``serve.drain_ms`` (None = no budget),
+        and whatever the budget cuts off terminates as ``drained``.
+        ``journal`` (serving/recovery.ReplayJournal) records each
+        request's prompt + generated prefix so a replacement process can
+        replay live sequences token-identically.
+
+        The result dict carries per-request terminal ``statuses``, the
+        ``faults`` health-counter block, and the ``drain`` outcome next
+        to the existing throughput/latency numbers.
+        """
+        serve = self.serve
+        if serve.deadline_ms is not None:
+            # the default TTL: deadline = arrival + budget on the run's
+            # clock; an explicit per-request deadline wins
+            requests = [r if r.deadline is not None else
+                        dataclasses.replace(
+                            r, deadline=r.arrival + serve.deadline_ms / 1e3)
+                        for r in requests]
+        self._journal = journal
+        if journal is not None:
+            self.sched.on_terminal = journal.record_end
         pending = sorted(requests, key=lambda r: r.arrival)
         token_times: dict = {}                  # request id -> [latency]
         last_emit: dict = {}                    # request id -> stamp
+        draining, drain_t0, fin_at_drain, shed_at_drain = False, 0.0, 0, 0
         t0 = time_fn()
         while pending or not self.sched.all_done():
             now = time_fn() - t0
+            if guard is not None and guard.should_stop and not draining:
+                # graceful drain: stop admission, shed everything not in
+                # flight, let live sequences finish inside the budget
+                draining = True
+                drain_t0 = now
+                fin_at_drain = len(self.sched.finished)
+                shed_at_drain = len(pending)
+                for req in pending:
+                    self.sched.fail_request(req, "shed")
+                pending = []
+                shed_at_drain += self.sched.shed_waiting()
+            if draining and serve.drain_ms is not None \
+                    and (now - drain_t0) * 1e3 > serve.drain_ms:
+                # budget's hard edge: cut whatever is still in flight
+                self.sched.abort_live("drained")
+                break
             while pending and pending[0].arrival <= now:
                 req = pending.pop(0)
-                self.sched.submit(req)
+                if journal is not None:
+                    journal.record_submit(req)
+                rej = self.sched.submit(req)
+                if rej is not None:
+                    continue    # terminal status recorded; engine lives
                 last_emit[req.id] = req.arrival
                 token_times[req.id] = []
+            # deadline sweep BEFORE the step: expired work must not buy
+            # another dispatch's worth of pool time
+            self.sched.expire_deadlines(now)
+            # step() journals each token at emission, BEFORE the terminal
+            # hook can fire — the durable order is tok-then-end, so an
+            # end-ok can never precede its own finishing token
             emitted = self.step()
             now = time_fn() - t0
-            for rid, _tok in emitted:
-                token_times[rid].append(now - last_emit[rid])
-                last_emit[rid] = now
+            for rid, tok in emitted:
+                if rid in last_emit:
+                    token_times[rid].append(now - last_emit[rid])
+                    last_emit[rid] = now
             # AFTER the emit accounting: an eviction discards the
             # request's samples so far — including a token emitted this
             # very step (prefill-final then evicted by a later slot's
             # ensure_block); only the final delivered stream counts
             for rid in self.sched.evicted_ids:
+                if journal is not None:
+                    journal.record_evict(rid)
                 token_times[rid] = []
                 last_emit[rid] = now
             self.sched.evicted_ids.clear()
-            if not emitted and pending and self.sched.all_done():
-                # idle gap before the next arrival: wait it out
-                time.sleep(min(1e-3, max(0.0, pending[0].arrival - now)))
+            if not emitted and not self._progressed:
+                # no work moved this iteration (idle gap before the next
+                # arrival, or live-but-stalled slots): sleep instead of
+                # busy-spinning a host core at 100%
+                delay = 1e-3
+                if pending:
+                    delay = min(delay, max(0.0, pending[0].arrival - now))
+                if delay > 0:
+                    time.sleep(delay)
         elapsed = time_fn() - t0
         outputs = {s.request.id: list(s.generated)
                    for s in self.sched.finished}
         total = sum(len(v) for v in outputs.values())
         flat = [x for ts in token_times.values() for x in ts]
         lat = np.asarray(flat) if flat else np.zeros(1)
+        from mpi_tensorflow_tpu.utils.metrics_writer import faults_block
+
         return {
             "outputs": outputs,
+            "statuses": dict(self.sched.statuses),
+            "faults": faults_block(self.sched.counters),
+            "drain": {
+                "requested": draining,
+                # finished after the stop request = drained to completion
+                "drained": len(self.sched.finished) - fin_at_drain
+                if draining else 0,
+                "shed": shed_at_drain if draining else 0,
+                "cut": int(self.sched.counters["drained"]),
+                "budget_ms": serve.drain_ms,
+            },
             "tokens": total,
             "elapsed_s": elapsed,
             "tokens_per_sec": total / elapsed if elapsed > 0 else 0.0,

@@ -1,0 +1,259 @@
+"""Crash recovery for serving: host-side replay journal + supervision.
+
+The training side already has a three-layer recovery story (preemption
+guard -> durable checkpoint -> elastic restart, train/elastic.py); this
+module is the serving equivalent.  The key asset is that greedy decode
+is DETERMINISTIC: for a fixed model+params, the tokens following any
+prompt are a pure function of the prompt.  So the durable state a
+serving process needs is tiny and already on the host — each request's
+prompt plus the prefix of tokens generated so far.  After a crash (or
+an in-process transient device failure), a live request replays as a
+fresh request whose prompt is ``original_prompt + generated_prefix``
+and whose budget is the remaining tokens: chunked prefill re-ingests
+the concatenation, the prefill-final argmax emits exactly the token the
+lost process would have emitted next, and the delivered stream
+``prefix + new_tokens`` is token-identical to an unfaulted run (pinned
+by tests/test_serving_recovery.py and the SIGKILL bench test).
+
+Layers:
+
+- ``ReplayJournal``   append-only JSONL of submit/token/evict/end
+                      records, mirrored in memory.  ``path=None`` keeps
+                      it memory-only (in-process retry); a path makes it
+                      durable across SIGKILL (line-buffered appends; a
+                      torn final line from a mid-write crash is
+                      ignored on load).
+- ``run_with_replay`` the supervisor: runs an engine over the journal,
+                      classifies failures with the SAME status-code-
+                      first ``train/elastic.is_transient`` logic the
+                      training supervisor uses, rebuilds pools/engine on
+                      transient device loss, and replays live sequences.
+                      Non-transient errors (shape bugs, OOM) re-raise
+                      immediately — a deterministic bug replayed forever
+                      is a worse failure mode.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from collections import Counter
+from typing import Callable, Dict, List, Optional
+
+from mpi_tensorflow_tpu.serving.scheduler import Request
+from mpi_tensorflow_tpu.train import elastic
+
+
+@dataclasses.dataclass
+class JournalEntry:
+    """Replay state of one request: the submitted prompt, any tokens
+    already delivered BEFORE this submit (``pre`` — non-empty only on a
+    replay submit, whose prompt embeds them), tokens generated since,
+    and the terminal status once one is recorded."""
+    prompt: List[int]
+    max_new_tokens: int
+    arrival: float
+    pre: List[int] = dataclasses.field(default_factory=list)
+    toks: List[int] = dataclasses.field(default_factory=list)
+    status: Optional[str] = None
+
+    @property
+    def delivered(self) -> List[int]:
+        """The output stream as the client sees it so far."""
+        return self.pre + self.toks
+
+
+class ReplayJournal:
+    """Append-only request journal, host-side, optionally durable.
+
+    Record kinds (one JSON object per line):
+      {"kind": "submit", "id", "prompt", "n", "arrival", "pre"}
+      {"kind": "tok",    "id", "t"}
+      {"kind": "evict",  "id"}          # restart-from-scratch: tokens
+                                        # since the last submit are void
+      {"kind": "end",    "id", "status"}
+
+    Constructing with an existing ``path`` LOADS it first — the crash-
+    recovery entry point — then appends.  All writes also update the
+    in-memory state, so in-process retries need no reload.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self.entries: Dict[int, JournalEntry] = {}
+        self.statuses: Dict[int, str] = {}
+        # delivered-so-far per replayed id, staged by replay_requests so
+        # the engine's plain record_submit(req) journals the right "pre"
+        self._pending_pre: Dict[int, List[int]] = {}
+        self._fh = None
+        if path is not None:
+            if os.path.exists(path):
+                self._load(path)
+            self._fh = open(path, "a", buffering=1)   # line-buffered:
+            # each record is durable as soon as the line completes
+
+    # ---------------- load ----------------
+
+    def _load(self, path: str) -> None:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue    # torn final line from a mid-write crash
+                self._apply(rec)
+
+    def _apply(self, rec: dict) -> None:
+        kind, rid = rec.get("kind"), rec.get("id")
+        if kind == "submit":
+            self.entries[rid] = JournalEntry(
+                prompt=list(rec["prompt"]), max_new_tokens=int(rec["n"]),
+                arrival=float(rec.get("arrival", 0.0)),
+                pre=list(rec.get("pre", ())))
+        elif kind == "tok" and rid in self.entries:
+            self.entries[rid].toks.append(int(rec["t"]))
+        elif kind == "evict" and rid in self.entries:
+            # restart-from-scratch preemption: the discarded tokens are
+            # regenerated verbatim (greedy determinism), so the journal
+            # forgets them exactly like the latency accounting does
+            self.entries[rid].toks.clear()
+        elif kind == "end":
+            self.statuses[rid] = rec["status"]
+            if rid in self.entries:
+                self.entries[rid].status = rec["status"]
+
+    # ---------------- write ----------------
+
+    def _write(self, rec: dict) -> None:
+        self._apply(rec)
+        if self._fh is not None:
+            self._fh.write(json.dumps(rec) + "\n")
+
+    def record_submit(self, req: Request,
+                      pre: Optional[List[int]] = None) -> None:
+        if pre is None:
+            pre = self._pending_pre.pop(req.id, [])
+        self._write({"kind": "submit", "id": req.id,
+                     "prompt": list(req.prompt), "n": req.max_new_tokens,
+                     "arrival": req.arrival, "pre": list(pre)})
+
+    def record_token(self, rid: int, tok: int) -> None:
+        self._write({"kind": "tok", "id": rid, "t": int(tok)})
+
+    def record_evict(self, rid: int) -> None:
+        self._write({"kind": "evict", "id": rid})
+
+    def record_end(self, req: Request, status: str) -> None:
+        self._write({"kind": "end", "id": req.id, "status": status})
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # ---------------- replay assembly ----------------
+
+    def replay_requests(self, requests: List[Request],
+                        eos_id: Optional[int] = None) -> List[Request]:
+        """The request list a replacement engine run should serve:
+        never-journaled requests as-is; live (no terminal status)
+        requests re-rooted at ``prompt + delivered`` with the remaining
+        budget; terminated requests omitted.  Deadlines are dropped on
+        replay — they were stamped on the dead process's clock, and the
+        replacement run's clock restarts at zero (honoring stale stamps
+        would mass-expire recovered work on arrival)."""
+        out = []
+        for req in requests:
+            ent = self.entries.get(req.id)
+            if ent is None:
+                if req.id in self.statuses:
+                    continue          # rejected/shed before ever admitted
+                out.append(req)
+                continue
+            if ent.status is not None:
+                continue
+            done = ent.delivered
+            if eos_id is not None and eos_id in done:
+                done = done[:done.index(eos_id) + 1]
+            remaining = ent.max_new_tokens + len(ent.pre) - len(done)
+            if remaining <= 0 or (eos_id is not None and done
+                                  and done[-1] == eos_id):
+                # crashed between the final token and its end record
+                self.record_end(req, "ok")
+                continue
+            self._pending_pre[req.id] = done
+            out.append(Request(req.id, list(req.prompt) + done, remaining,
+                               arrival=0.0, replayed=True))
+        return out
+
+    def outputs(self) -> Dict[int, List[int]]:
+        """Delivered streams of every completed (``ok``) request."""
+        return {rid: ent.delivered for rid, ent in self.entries.items()
+                if ent.status == "ok"}
+
+
+def run_with_replay(make_engine: Callable[[], "object"],
+                    requests: List[Request], *,
+                    journal: Optional[ReplayJournal] = None,
+                    journal_path: Optional[str] = None,
+                    max_restarts: int = 3,
+                    backoff_seconds: float = 0.0,
+                    is_transient_fn: Callable[[BaseException],
+                                              bool] = elastic.is_transient,
+                    guard=None, time_fn=time.perf_counter) -> dict:
+    """Serve ``requests`` through a journaled engine, surviving transient
+    failures by rebuilding the engine (fresh pools — device state is
+    presumed lost) and replaying live sequences through chunked prefill.
+
+    ``make_engine`` is a zero-arg factory returning a fresh
+    ``PagedDecodeEngine`` (the serving analogue of elastic's
+    idempotent-from-checkpoint ``train_fn``).  Failure classification is
+    ``train/elastic.is_transient`` — status-code-first, so a reworded
+    device-loss message still replays while a deterministic shape bug
+    still raises.  Returns the final run's stats dict with ``outputs``
+    and ``statuses`` merged across every attempt (journal-complete) and
+    ``faults`` aggregated, including the ``replays`` count.
+    """
+    if journal is None:
+        journal = ReplayJournal(journal_path)
+    totals: Counter = Counter()
+    attempt = 0
+    while True:
+        engine = None
+        try:
+            # the rebuild itself can hit the still-recovering device —
+            # it must be classified and retried like the run
+            engine = make_engine()
+            todo = journal.replay_requests(requests,
+                                           eos_id=engine.serve.eos_id)
+            res = engine.run(todo, journal=journal, guard=guard,
+                             time_fn=time_fn)
+            totals.update(engine.sched.counters)
+            break
+        except Exception as e:     # noqa: BLE001 — classified right below
+            if engine is not None:
+                totals.update(engine.sched.counters)
+            if not is_transient_fn(e) or attempt >= max_restarts:
+                raise
+            attempt += 1
+            print(f"[serving-recovery] transient failure ({e!r}); "
+                  f"rebuilding engine, replay {attempt}/{max_restarts}")
+            if backoff_seconds > 0:
+                time.sleep(backoff_seconds)
+    totals["replays"] += attempt
+    res["outputs"] = journal.outputs()
+    res["statuses"] = dict(journal.statuses)
+    # res["tokens"]/elapsed_s/tokens_per_sec stay the FINAL attempt's own
+    # (internally consistent throughput); the journal-merged stream total
+    # across every attempt gets its own key
+    res["delivered_tokens"] = sum(len(v) for v in res["outputs"].values())
+    from mpi_tensorflow_tpu.utils.metrics_writer import faults_block
+
+    res["faults"] = faults_block(totals)
+    res["replays"] = attempt
+    return res

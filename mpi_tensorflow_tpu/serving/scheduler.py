@@ -8,6 +8,24 @@ recycled the step it finishes (EOS or token budget) — a long request no
 longer holds a whole batch hostage, and finished rows stop burning MXU
 cycles on masked steps.
 
+Failure handling is structured, never an engine crash: every request
+leaves the system with exactly one terminal status —
+
+- ``ok``                 finished (EOS or token budget);
+- ``rejected``           infeasible at submit (can never fit the pool /
+                         malformed), or — defensively — a live sequence
+                         the pool can no longer grow with nothing left
+                         to evict;
+- ``shed``               dropped by load shedding: the bounded waiting
+                         queue was full (reject-newest, ``queue_full``),
+                         or admission stopped for a drain;
+- ``deadline_exceeded``  its deadline passed before completion;
+- ``evicted_too_often``  preempted more than ``max_evictions`` times
+                         (livelock guard: requeue-at-head forever is a
+                         starvation engine, not progress);
+- ``drained``            in flight when a graceful drain's budget
+                         expired (the engine cut it off incomplete).
+
 All state here is host-side Python; the engine turns the live slot set
 into bucketed device dispatches.  Pure-Python on purpose: the
 admit/evict invariant tests run without a device.
@@ -16,22 +34,49 @@ admit/evict invariant tests run without a device.
 from __future__ import annotations
 
 import dataclasses
-from collections import deque
-from typing import List, Optional
+from collections import Counter, deque
+from typing import Callable, Dict, List, Optional
 
 from mpi_tensorflow_tpu.serving.paged_cache import (BlockAllocator,
                                                     blocks_for)
+
+#: every terminal status a request can leave the scheduler with
+TERMINAL_STATUSES = ("ok", "rejected", "shed", "deadline_exceeded",
+                     "evicted_too_often", "drained")
 
 
 @dataclasses.dataclass
 class Request:
     """One generation request.  ``arrival`` is in seconds on the caller's
     clock; the engine admits a request only once the clock passes it
-    (the bench harness replays Poisson traces through this)."""
+    (the bench harness replays Poisson traces through this).
+    ``deadline`` is an absolute stamp on the same clock: a request not
+    COMPLETE by then fails with ``deadline_exceeded`` instead of
+    occupying a slot (None = no deadline)."""
     id: int
     prompt: List[int]
     max_new_tokens: int
     arrival: float = 0.0
+    deadline: Optional[float] = None
+    replayed: bool = False        # crash-recovery resubmission: it
+                                  # passed admission control once and
+                                  # carries delivered tokens, so load
+                                  # shedding must not drop it (the
+                                  # feasibility check still applies)
+
+
+@dataclasses.dataclass(frozen=True)
+class RejectedRequest:
+    """Structured admission refusal — the submit() result that replaces
+    the engine-killing exception.  ``reason`` is the machine-readable
+    cause (``infeasible`` | ``bad_request`` | ``queue_full``); ``status``
+    is the terminal status recorded for the request."""
+    request: Request
+    reason: str
+    status: str
+
+    def __bool__(self) -> bool:          # `if sched.submit(req):` reads
+        return True                      # as "was it rejected"
 
 
 @dataclasses.dataclass
@@ -66,40 +111,101 @@ class Scheduler:
     free) the YOUNGEST sequence is evicted back to the queue head —
     restart-from-scratch preemption, blocks freed, FIFO fairness for the
     oldest.  Invariants (pinned by tests): a block belongs to at most
-    one live sequence; evicted/finished sequences return every block;
-    free+used always partitions the pool.
+    one live sequence; evicted/finished/failed sequences return every
+    block; free+used always partitions the pool.
+
+    Robustness knobs (all optional; None keeps the unguarded behavior):
+
+    - ``queue_depth``     bounds ``waiting``; a submit that finds it full
+                          is load-shed (reject-newest, ``queue_full``) —
+                          backpressure instead of unbounded buildup.
+    - ``max_evictions``   a request may be evicted-and-requeued at most
+                          this many times; the next eviction fails it
+                          with ``evicted_too_often``.
+    - ``starvation_steps``  aging guard: when the HEAD of the queue (the
+                          oldest request, including evicted requeues)
+                          has been block-starved for this many admit
+                          calls, sequences YOUNGER than it are preempted
+                          to free blocks for it — a hot arrival stream
+                          cannot park old work forever.
+    - ``on_terminal(request, status)``  fired exactly once per request
+                          as it leaves the system (journal hook).
     """
 
     def __init__(self, allocator: BlockAllocator, max_slots: int,
-                 block_size: int, max_blocks_per_seq: int):
+                 block_size: int, max_blocks_per_seq: int, *,
+                 queue_depth: Optional[int] = None,
+                 max_evictions: Optional[int] = None,
+                 starvation_steps: Optional[int] = 64,
+                 on_terminal: Optional[Callable[[Request, str],
+                                                None]] = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.allocator = allocator
         self.max_slots = max_slots
         self.block_size = block_size
         self.max_blocks_per_seq = max_blocks_per_seq
+        self.queue_depth = queue_depth
+        self.max_evictions = max_evictions
+        self.starvation_steps = starvation_steps
+        self.on_terminal = on_terminal
         self.waiting: deque = deque()
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.finished: List[Sequence] = []
+        self.failed: List[Request] = []
+        self.statuses: Dict[int, str] = {}     # request id -> terminal
+        self.counters: Counter = Counter()     # faults_block feeds off this
         self.evictions = 0
         self.evicted_ids: List[int] = []   # request ids, drained by the
                                            # engine's latency accounting
+        self.evict_counts: Counter = Counter()  # per-request preemptions
+        self._head_blocked = 0             # admit calls the queue head has
+                                           # been starved of blocks
+        self._head_blocked_id = None       # ...and WHICH head: credit must
+                                           # not transfer to a successor
+
+    # ---------------- terminal bookkeeping ----------------
+
+    def _terminal(self, req: Request, status: str) -> None:
+        """Record a request's one terminal status (+ journal hook)."""
+        self.statuses[req.id] = status
+        if status != "ok":
+            self.counters[status] += 1
+            self.failed.append(req)
+        if self.on_terminal is not None:
+            self.on_terminal(req, status)
 
     # ---------------- queue / admission ----------------
 
-    def submit(self, req: Request) -> None:
+    def submit(self, req: Request) -> Optional[RejectedRequest]:
+        """Feasibility-checked admission to the waiting queue.  Returns
+        None on accept, a structured ``RejectedRequest`` otherwise — an
+        infeasible or malformed request terminates with a status; it
+        never raises into (and never crashes) the engine."""
         if not req.prompt or req.max_new_tokens < 1:
-            raise ValueError(
-                f"request {req.id}: needs a non-empty prompt and "
-                f"max_new_tokens >= 1")
+            return self._reject(req, "bad_request", "rejected")
         total = len(req.prompt) + req.max_new_tokens
         cap = self.max_blocks_per_seq * self.block_size
-        if total > cap:
-            raise ValueError(
-                f"request {req.id}: prompt+output {total} exceeds the "
-                f"per-sequence cache capacity {cap} "
-                f"({self.max_blocks_per_seq} blocks x {self.block_size})")
+        pool_cap = (self.allocator.num_blocks - 1) * self.block_size
+        if total > cap or total > pool_cap:
+            # can NEVER fit, even with every other sequence evicted —
+            # admitting it would guarantee a mid-stream dead end
+            return self._reject(req, "infeasible", "rejected")
+        if self.queue_depth is not None and not req.replayed \
+                and len(self.waiting) >= self.queue_depth:
+            # bounded queue: reject-newest load shedding (the oldest
+            # waiting work keeps its place; backpressure lands on the
+            # arrival stream, where the client can retry elsewhere).
+            # Replayed requests are exempt: shedding recovered work
+            # would orphan its already-delivered prefix
+            return self._reject(req, "queue_full", "shed")
         self.waiting.append(req)
+        return None
+
+    def _reject(self, req: Request, reason: str,
+                status: str) -> RejectedRequest:
+        self._terminal(req, status)
+        return RejectedRequest(req, reason, status)
 
     def free_slot(self) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -112,7 +218,12 @@ class Scheduler:
         Returns the slot indices admitted this call (they need prefill).
         FIFO head-of-line: if the oldest request does not fit, nothing
         behind it jumps the queue — admission order stays arrival order
-        (the latency numbers the bench reports depend on it)."""
+        (the latency numbers the bench reports depend on it).
+
+        Aging guard: a head blocked on blocks for ``starvation_steps``
+        consecutive admit calls preempts sequences YOUNGER than itself
+        to free the blocks it needs — requeued (evicted) old work makes
+        progress even under a hot stream of later arrivals."""
         admitted = []
         while self.waiting:
             slot = self.free_slot()
@@ -121,7 +232,23 @@ class Scheduler:
             req = self.waiting[0]
             need = blocks_for(len(req.prompt) + 1, self.block_size)
             if not self.allocator.can_alloc(need):
+                if self._head_blocked_id != req.id:
+                    # a different head (the old one admitted/expired):
+                    # starvation credit starts over
+                    self._head_blocked_id = req.id
+                    self._head_blocked = 0
+                self._head_blocked += 1
+                if self.starvation_steps is not None \
+                        and self._head_blocked > self.starvation_steps \
+                        and self._evict_youngest(
+                            protect=None, younger_than=req.arrival,
+                            requeue_pos=1):
+                    # victim requeues BEHIND the aged head (position 1):
+                    # appendleft would put younger work back in front of
+                    # the very request the guard exists to unblock
+                    continue
                 break
+            self._head_blocked = 0
             self.waiting.popleft()
             self.slots[slot] = Sequence(req, self.allocator.alloc(need))
             admitted.append(slot)
@@ -148,22 +275,43 @@ class Scheduler:
             seq.block_ids.extend(self.allocator.alloc(1))
         return True
 
-    def _evict_youngest(self, protect: int) -> bool:
+    def _evict_youngest(self, protect: Optional[int],
+                        younger_than: Optional[float] = None,
+                        requeue_pos: int = 0) -> bool:
         """Preempt the youngest live sequence (restart-from-scratch):
-        free its blocks, requeue its request at the queue HEAD so it
-        re-admits before anything that arrived after it."""
+        free its blocks, requeue its request at ``requeue_pos`` in the
+        queue (0 = the head, so it re-admits before anything that
+        arrived after it).  ``younger_than`` restricts candidates to
+        arrivals strictly after that stamp (the aging guard must never
+        preempt work older than the request it serves).  A victim past
+        its ``max_evictions`` budget is failed with ``evicted_too_often``
+        instead of requeued — its blocks still free, so the caller's
+        allocation can proceed either way."""
         candidates = [(self.slots[i].request.arrival, i)
                       for i in range(self.max_slots)
-                      if self.slots[i] is not None and i != protect]
+                      if self.slots[i] is not None and i != protect
+                      and (younger_than is None
+                           or self.slots[i].request.arrival > younger_than)]
         if not candidates:
             return False
         _, victim = max(candidates)
         seq = self.slots[victim]
         self.allocator.free(seq.block_ids)
-        self.waiting.appendleft(seq.request)
         self.slots[victim] = None
         self.evictions += 1
+        self.counters["evictions"] += 1
         self.evicted_ids.append(seq.request.id)
+        self.evict_counts[seq.request.id] += 1
+        if self.max_evictions is not None \
+                and self.evict_counts[seq.request.id] > self.max_evictions:
+            # livelock guard: K restarts bought no completion — fail it
+            # rather than let requeue-at-head churn the pool forever
+            self._terminal(seq.request, "evicted_too_often")
+            return True
+        if requeue_pos <= 0 or not self.waiting:
+            self.waiting.appendleft(seq.request)
+        else:
+            self.waiting.insert(requeue_pos, seq.request)
         return True
 
     def record_token(self, slot: int, token: int,
@@ -179,6 +327,63 @@ class Scheduler:
             seq.block_ids = []
             self.finished.append(seq)
             self.slots[slot] = None
+            self._terminal(seq.request, "ok")
+
+    # ---------------- failure / drain surface ----------------
+
+    def fail_request(self, req: Request, status: str) -> None:
+        """Terminate a request that is NOT in the scheduler (e.g. a
+        pending arrival shed at drain start) with ``status``."""
+        self._terminal(req, status)
+
+    def fail_live(self, slot: int, status: str) -> None:
+        """Terminate ONE live sequence with ``status``: free its blocks,
+        recycle the slot — the other in-flight streams keep serving."""
+        seq = self.slots[slot]
+        self.allocator.free(seq.block_ids)
+        seq.block_ids = []
+        self.slots[slot] = None
+        self._terminal(seq.request, status)
+
+    def expire_deadlines(self, now: float) -> List[int]:
+        """Fail every waiting or live request whose deadline has passed
+        (``deadline_exceeded``); expired work must stop occupying slots
+        and blocks that feasible requests could use.  Returns the
+        expired request ids."""
+        expired = []
+        survivors = deque()
+        for req in self.waiting:
+            if req.deadline is not None and now >= req.deadline:
+                self._terminal(req, "deadline_exceeded")
+                expired.append(req.id)
+            else:
+                survivors.append(req)
+        self.waiting = survivors
+        for i, seq in enumerate(self.slots):
+            if seq is not None and seq.request.deadline is not None \
+                    and now >= seq.request.deadline:
+                expired.append(seq.request.id)
+                self.fail_live(i, "deadline_exceeded")
+        return expired
+
+    def shed_waiting(self, status: str = "shed") -> int:
+        """Drop the whole waiting queue — drain-start load shedding:
+        admission has stopped, and queued work is not in flight."""
+        n = len(self.waiting)
+        while self.waiting:
+            self._terminal(self.waiting.popleft(), status)
+        return n
+
+    def abort_live(self, status: str) -> int:
+        """Terminate every live sequence AND any residual waiting work
+        (eviction victims requeued mid-drain) with ``status`` — the
+        drain budget's hard edge."""
+        n = self.shed_waiting(status)
+        for i, seq in enumerate(self.slots):
+            if seq is not None:
+                self.fail_live(i, status)
+                n += 1
+        return n
 
     def all_done(self) -> bool:
         return not self.waiting and all(s is None for s in self.slots)

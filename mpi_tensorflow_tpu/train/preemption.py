@@ -11,10 +11,18 @@ only sets a flag (async-signal-safe), the training loop polls it at step
 granularity, saves a checkpoint, and exits cleanly; ``--resume`` then
 continues from the saved step.  ``request_stop()`` triggers the same path
 programmatically (tests, notebook interrupts, external schedulers).
+
+The SAME guard drives the serving engine's graceful drain
+(serving/engine.py ``run(..., guard=...)``): SIGTERM stops admission,
+in-flight sequences finish inside the drain budget, and the run reports
+per-request drained-vs-shed outcomes.  ``installed()`` is the
+context-manager form both entry points use — handlers are guaranteed
+uninstalled on the way out, even when the serve/train body raises.
 """
 
 from __future__ import annotations
 
+import contextlib
 import signal
 import threading
 from typing import Iterable, Optional
@@ -68,3 +76,19 @@ class PreemptionGuard:
         for s, prev in self._prev.items():
             signal.signal(s, prev)
         self._prev.clear()
+
+    # -- context-manager form --
+
+    @classmethod
+    @contextlib.contextmanager
+    def installed(cls, signals: Iterable[int] = (signal.SIGTERM,)):
+        """``with PreemptionGuard.installed() as guard:`` — install the
+        handlers for the block and ALWAYS restore the previous ones,
+        even when the guarded body raises (a serve loop that dies with
+        handlers still hijacked would turn the supervisor's next SIGTERM
+        into a silent no-op)."""
+        guard = cls.install(signals=signals)
+        try:
+            yield guard
+        finally:
+            guard.uninstall()

@@ -81,3 +81,32 @@ class MetricsWriter:
 def for_process(log_dir: Optional[str], process_index: int) -> MetricsWriter:
     """Writer that is active on process 0 only (scalars are global)."""
     return MetricsWriter(log_dir, enabled=process_index == 0)
+
+
+#: canonical serving health-counter keys — THE shape of the ``faults``
+#: block every consumer sees (engine result dicts, the recovery
+#: supervisor's merged totals, bench.py --mode serving JSON).  One
+#: definition so a dashboard keyed on these names never drifts from the
+#: engine's accounting.
+SERVING_FAULT_KEYS = ("rejected", "shed", "deadline_exceeded",
+                      "evicted_too_often", "drained", "evictions",
+                      "replays")
+
+
+def faults_block(counters) -> dict:
+    """Normalize a scheduler/supervisor counter mapping into the
+    canonical serving ``faults`` block: every key present (0 when the
+    counter never fired), values plain ints."""
+    return {k: int(counters.get(k, 0)) for k in SERVING_FAULT_KEYS}
+
+
+def write_faults(writer: MetricsWriter, counters, step: int = 0,
+                 prefix: str = "serving/faults/") -> dict:
+    """Stream the normalized faults block through a MetricsWriter (one
+    scalar per counter, ``serving/faults/<key>``) and return it — the
+    emission path for a serve loop with a ``--metrics-dir``-style sink;
+    it normalizes through ``faults_block`` so the scalar stream and a
+    printed JSON block built from the same counters cannot disagree."""
+    block = faults_block(counters)
+    writer.scalars({prefix + k: v for k, v in block.items()}, step)
+    return block

@@ -95,6 +95,83 @@ def _read_until(proc, pred, deadline_s):
     return lines, False
 
 
+class TestServingSigkillReplay:
+    """The serving analogue of TestSigkillResume: SIGKILL a real
+    ``bench.py --mode serving`` process mid-decode (no grace, no signal
+    handler), relaunch with the same replay journal, and require the
+    recovered outputs to be TOKEN-IDENTICAL to an unfaulted run —
+    greedy decode is deterministic, so the journal's prompt+prefix
+    replay is exact."""
+
+    def _bench(self, env, journal, extra=()):
+        args = ["bench.py", "--mode", "serving", "--serve-tiny",
+                "--precision", "fp32", "--requests", "6",
+                "--prompt-len", "12", "--new-tokens", "80",
+                "--arrival-rate", "1000",
+                "--serve-journal", journal] + list(extra)
+        return subprocess.Popen([sys.executable] + args, cwd=REPO, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    @staticmethod
+    def _outputs(proc_stdout: str) -> dict:
+        import json
+
+        rec = json.loads(proc_stdout.strip().splitlines()[-1])
+        return rec["detail"]["outputs"], rec["detail"]["statuses"]
+
+    def test_sigkill_mid_decode_then_replay_token_identical(self, tmp_path):
+        env = _cli_env()
+        journal = str(tmp_path / "serve_journal.jsonl")
+
+        # run 1: SIGKILL once the journal shows live mid-decode work
+        # (tokens recorded, nothing near the ~460-token completion)
+        proc = self._bench(env, journal)
+        try:
+            t0 = time.time()
+            killed = False
+            while time.time() - t0 < 600:
+                if proc.poll() is not None:
+                    break
+                try:
+                    with open(journal) as f:
+                        toks = sum('"tok"' in ln for ln in f)
+                except OSError:
+                    toks = 0
+                if toks >= 8:
+                    proc.send_signal(signal.SIGKILL)   # no grace
+                    proc.wait(timeout=30)
+                    killed = True
+                    break
+                time.sleep(0.005)
+            assert killed, "bench run never reached mid-decode state"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        # the journal must hold live (unterminated) work — a real crash
+        from mpi_tensorflow_tpu.serving import ReplayJournal
+
+        state = ReplayJournal(journal)
+        live = [rid for rid, e in state.entries.items() if e.status is None]
+        state.close()
+        assert live, "SIGKILL landed after completion; nothing to replay"
+
+        # run 2: same journal — resumes and completes
+        proc2 = self._bench(env, journal)
+        out2, _ = proc2.communicate(timeout=900)
+        assert proc2.returncode == 0, out2
+        got, statuses = self._outputs(out2)
+        assert set(statuses.values()) == {"ok"}, statuses
+
+        # run 3: unfaulted reference with a fresh journal
+        proc3 = self._bench(env, str(tmp_path / "clean.jsonl"))
+        out3, _ = proc3.communicate(timeout=900)
+        assert proc3.returncode == 0, out3
+        want, _ = self._outputs(out3)
+        assert got == want, "recovered outputs diverged from unfaulted run"
+
+
 class TestSigkillResume:
     def test_sigkill_mid_run_then_resume(self, tmp_path):
         """Kill -9 the training process after checkpoints commit; the

@@ -45,6 +45,29 @@ class TestMetricsWriter:
         mw.close()
         assert not os.path.exists(os.path.join(d, "metrics.jsonl"))
 
+    def test_faults_block_normalizes_counters(self):
+        """The canonical serving faults block: every key present (0 when
+        the counter never fired), plain ints — the one shape engine
+        results, the recovery supervisor, and bench JSON all share."""
+        from collections import Counter
+
+        block = metrics_writer.faults_block(Counter(shed=2, evictions=5))
+        assert set(block) == set(metrics_writer.SERVING_FAULT_KEYS)
+        assert block["shed"] == 2 and block["evictions"] == 5
+        assert block["deadline_exceeded"] == 0 and block["replays"] == 0
+        assert all(type(v) is int for v in block.values())
+
+    def test_write_faults_streams_one_scalar_per_counter(self, tmp_path):
+        d = str(tmp_path / "m")
+        with metrics_writer.MetricsWriter(d) as mw:
+            block = metrics_writer.write_faults(mw, {"rejected": 3}, step=7)
+        assert block["rejected"] == 3
+        recs = read_jsonl(d)
+        tags = {r["tag"]: r["value"] for r in recs}
+        assert tags["serving/faults/rejected"] == 3
+        assert tags["serving/faults/drained"] == 0
+        assert all(r["step"] == 7 for r in recs)
+
     def test_image_loop_streams_metrics(self, tmp_path, mesh8, mnist_dir):
         from mpi_tensorflow_tpu.config import Config
         from mpi_tensorflow_tpu.data import mnist

@@ -150,10 +150,110 @@ class TestScheduler:
         assert s.evictions == 1
         s.allocator.check()
 
-    def test_over_capacity_request_rejected(self):
+    def test_over_capacity_request_rejected_structured(self):
+        """An infeasible request terminates with a structured status —
+        it never raises into (or crashes) the engine."""
         s = self._mk(bs=4, nb_per_seq=2)     # cap 8 tokens
-        with pytest.raises(ValueError, match="exceeds"):
-            s.submit(Request(0, [1] * 6, 4))
+        rej = s.submit(Request(0, [1] * 6, 4))
+        assert rej is not None and rej.reason == "infeasible"
+        assert s.statuses[0] == "rejected"
+        assert not s.waiting and s.counters["rejected"] == 1
+
+    def test_bad_request_rejected_structured(self):
+        s = self._mk()
+        assert s.submit(Request(0, [], 4)).reason == "bad_request"
+        assert s.submit(Request(1, [1, 2], 0)).reason == "bad_request"
+        assert s.statuses == {0: "rejected", 1: "rejected"}
+
+    def test_bounded_queue_sheds_newest(self):
+        """Load shedding: a full waiting queue rejects the NEWEST submit
+        with a queue_full reason; the oldest queued work keeps its
+        place."""
+        s = Scheduler(BlockAllocator(16), 1, 4, 4, queue_depth=2)
+        for i in range(2):
+            assert s.submit(Request(i, [1, 2], 2)) is None
+        rej = s.submit(Request(2, [1, 2], 2))
+        assert rej.reason == "queue_full" and rej.status == "shed"
+        assert [r.id for r in s.waiting] == [0, 1]
+        assert s.statuses[2] == "shed" and s.counters["shed"] == 1
+
+    def test_deadline_expiry_frees_queue_and_slots(self):
+        """Expired work stops occupying anything: waiting entries drop,
+        live sequences free every block."""
+        s = self._mk()
+        s.submit(Request(0, [1, 2, 3], 4, arrival=0.0, deadline=1.0))
+        s.submit(Request(1, [1, 2], 4, arrival=0.0, deadline=9.0))
+        for slot in s.admit():
+            s.slots[slot].prefilled = len(s.slots[slot].request.prompt)
+        assert s.expire_deadlines(0.5) == []
+        assert sorted(s.expire_deadlines(2.0)) == [0]
+        assert s.statuses[0] == "deadline_exceeded"
+        assert s.counters["deadline_exceeded"] == 1
+        s.allocator.check()
+        # the survivor still owns its blocks and finishes normally
+        live = [i for i, q in enumerate(s.slots) if q is not None]
+        assert [s.slots[i].request.id for i in live] == [1]
+
+    def test_eviction_cap_fails_instead_of_requeueing(self):
+        """The livelock guard: a request evicted more than max_evictions
+        times terminates with evicted_too_often, blocks freed, queue
+        clean."""
+        s = Scheduler(BlockAllocator(7), 2, 4, 4, max_evictions=1)
+        s.submit(Request(0, [1] * 7, 8, arrival=0.0))
+        s.submit(Request(1, [1] * 7, 8, arrival=1.0))
+        assert len(s.admit()) == 2
+        for slot in (0, 1):
+            s.slots[slot].prefilled = 7
+        s.record_token(0, 3)
+        s.record_token(0, 4)                 # length 9: needs a 3rd block
+        s.allocator.alloc(2)                 # external pressure: 0 free
+        assert s.ensure_block(0)             # eviction 1: requeued
+        assert s.waiting[0].id == 1 and 1 not in s.statuses
+        # re-admit the victim, then force a second eviction
+        s.allocator.free([b for b in list(s.allocator._used)
+                          if b not in s.slots[0].block_ids])
+        for slot in s.admit():
+            s.slots[slot].prefilled = 7
+        s.record_token(0, 5)                 # length 10: 3 blocks cover
+        s.record_token(0, 6)                 # length 11
+        s.record_token(0, 7)                 # length 12
+        s.allocator.alloc(s.allocator.num_free)   # drain the pool again
+        s.record_token(0, 8)                 # length 13: needs a 4th
+        assert s.ensure_block(0)             # eviction 2: over the cap
+        assert s.statuses[1] == "evicted_too_often"
+        assert not s.waiting
+        assert s.counters["evicted_too_often"] == 1
+        assert s.evict_counts[1] == 2
+
+    def test_aging_guard_preempts_younger_for_starved_head(self):
+        """A block-starved queue head (e.g. an evicted requeue) preempts
+        sequences YOUNGER than itself after starvation_steps admit
+        calls — a hot arrival stream cannot park old work forever; the
+        victim requeues BEHIND the aged head."""
+        s = Scheduler(BlockAllocator(9), 2, 4, 8, starvation_steps=3)
+        s.submit(Request(1, [1] * 4, 2, arrival=1.0))   # younger, live
+        assert s.admit() == [0]
+        s.slots[0].prefilled = 4
+        s.allocator.alloc(s.allocator.num_free - 1)     # 1 block free
+        s.submit(Request(0, [1] * 8, 2, arrival=0.0))   # OLDER head,
+        for _ in range(3):                              # needs 3 blocks
+            assert s.admit() == []                      # starving...
+        got = s.admit()             # guard fires: younger seq preempted,
+        assert got                  # freeing the blocks the head needed
+        assert s.slots[got[0]].request.id == 0 and s.evictions == 1
+        assert [r.id for r in s.waiting] == [1], \
+            "victim must requeue BEHIND the head it starved"
+
+    def test_aging_guard_never_preempts_older_work(self):
+        s = Scheduler(BlockAllocator(9), 2, 4, 8, starvation_steps=2)
+        s.submit(Request(0, [1] * 4, 2, arrival=0.0))   # OLDER, live
+        assert s.admit() == [0]
+        s.slots[0].prefilled = 4
+        s.allocator.alloc(s.allocator.num_free - 1)
+        s.submit(Request(1, [1] * 8, 2, arrival=1.0))   # younger head
+        for _ in range(10):
+            assert s.admit() == []
+        assert s.slots[0] is not None and s.evictions == 0
 
     def test_scripted_trace_invariants(self):
         """Admit/decode/finish churn: at every step the pool partitions
@@ -339,6 +439,128 @@ class TestEngine:
         engine.allocator.check()
         assert engine.allocator.num_used == 0
 
+    def test_infeasible_request_never_crashes_the_engine(self):
+        """THE satellite fix for the engine-killing pool-exhaustion
+        raise: an infeasible request terminates with a structured
+        status, every other stream completes generate()-identically."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        _, _, engine = self._engine()
+        rng = np.random.default_rng(9)
+        good = _prompts(rng, 3, lo=3, hi=8)
+        reqs = [Request(i, p, 4) for i, p in enumerate(good)]
+        # prompt+output over the per-sequence cap (32): infeasible
+        reqs.insert(1, Request(99, list(map(int, rng.integers(
+            0, TINY.vocab_size, 30))), 10))
+        res = engine.run(reqs)
+        assert res["statuses"][99] == "rejected"
+        assert res["faults"]["rejected"] == 1
+        assert 99 not in res["outputs"]
+        for i, p in enumerate(good):
+            assert res["outputs"][i] == _generate_ref(model, params, p, 4)
+        assert engine.allocator.num_used == 0
+
+    def test_deadline_expiry_is_terminal_not_fatal(self):
+        """An expired request frees its slot and fails with
+        deadline_exceeded; the engine keeps serving the rest."""
+        _, _, engine = self._engine()
+        clock = {"t": 0.0}
+
+        def fake_time():
+            clock["t"] += 0.01
+            return clock["t"]
+
+        # id 0 can never finish 64 tokens before its 50ms deadline
+        res = engine.run(
+            [Request(0, [1, 2, 3], 20, arrival=0.0, deadline=0.05),
+             Request(1, [4, 5], 3, arrival=0.0)], time_fn=fake_time)
+        assert res["statuses"][0] == "deadline_exceeded"
+        assert res["statuses"][1] == "ok"
+        assert len(res["outputs"][1]) == 3 and 0 not in res["outputs"]
+        assert res["faults"]["deadline_exceeded"] == 1
+        assert engine.allocator.num_used == 0
+
+    def test_default_ttl_from_serve_config(self):
+        """serve.deadline_ms stamps arrival+TTL on every request that
+        has no explicit deadline — the --serve-deadline-ms knob."""
+        _, _, engine = self._engine(deadline_ms=50.0)
+        clock = {"t": 0.0}
+
+        def fake_time():
+            clock["t"] += 0.01
+            return clock["t"]
+
+        res = engine.run([Request(0, [1, 2, 3], 20, arrival=0.0)],
+                         time_fn=fake_time)
+        assert res["statuses"][0] == "deadline_exceeded"
+
+    def test_queue_depth_sheds_at_engine_level(self):
+        _, _, engine = self._engine(max_slots=1, queue_depth=1)
+        rng = np.random.default_rng(10)
+        reqs = [Request(i, p, 3)
+                for i, p in enumerate(_prompts(rng, 5, lo=3, hi=6))]
+        res = engine.run(reqs)
+        assert res["faults"]["shed"] >= 1
+        for i in range(5):      # every request left with SOME terminal
+            assert res["statuses"][i] in ("ok", "shed")
+        done = [i for i, s in res["statuses"].items() if s == "ok"]
+        assert sorted(res["outputs"]) == sorted(done)
+        assert engine.allocator.num_used == 0
+
+    def test_sigterm_drains_in_flight_and_sheds_queue(self):
+        """The graceful-drain acceptance pin: a stop request mid-run
+        stops admission, in-flight work finishes (budget permitting),
+        un-admitted work sheds, and the result reports both counts."""
+        from mpi_tensorflow_tpu.train.preemption import PreemptionGuard
+
+        _, _, engine = self._engine(max_slots=2)
+        rng = np.random.default_rng(11)
+        prompts = _prompts(rng, 6, lo=3, hi=8)
+        # late arrivals that a drain at t~0 must shed un-served
+        reqs = [Request(i, p, 8, arrival=0.0 if i < 2 else 1e9)
+                for i, p in enumerate(prompts)]
+        guard = PreemptionGuard()          # no signal wiring needed:
+        steps = {"n": 0}                   # request_stop == SIGTERM path
+
+        def fake_time():
+            steps["n"] += 1
+            if steps["n"] == 6:
+                guard.request_stop("SIGTERM")
+            return steps["n"] * 1e-4
+
+        res = engine.run(reqs, time_fn=fake_time, guard=guard)
+        assert res["drain"]["requested"]
+        assert res["drain"]["shed"] == 4
+        assert res["drain"]["drained"] + res["drain"]["cut"] >= 1
+        for i in range(2):
+            assert res["statuses"][i] in ("ok", "drained")
+        for i in range(2, 6):
+            assert res["statuses"][i] == "shed"
+        assert engine.allocator.num_used == 0
+
+    def test_drain_budget_cuts_unfinished_work(self):
+        """drain_ms = 0: the budget expires immediately — everything
+        still in flight terminates as `drained`, blocks freed."""
+        from mpi_tensorflow_tpu.train.preemption import PreemptionGuard
+
+        _, _, engine = self._engine(drain_ms=0.0)
+        guard = PreemptionGuard()
+        clock = {"t": 0.0}
+
+        def fake_time():
+            clock["t"] += 0.01
+            if clock["t"] > 0.2:
+                guard.request_stop()
+            return clock["t"]
+
+        res = engine.run([Request(0, [1, 2, 3], 25, arrival=0.0)],
+                         time_fn=fake_time, guard=guard)
+        assert res["statuses"][0] == "drained"
+        assert res["drain"]["cut"] == 1
+        assert engine.allocator.num_used == 0
+
     def test_arrival_stamps_gate_admission(self):
         """A request with a later arrival must not be admitted before its
         stamp on the engine's clock — the run must outlast the stamp."""
@@ -402,3 +624,31 @@ class TestServeCliGuards:
         # explicit overrides win; None means "use the Config value"
         s2 = ServeConfig.from_config(c, max_slots=2, block_size=None)
         assert s2.max_slots == 2 and s2.block_size == 8
+
+    def test_bad_serve_fault_policy_rejected(self):
+        from mpi_tensorflow_tpu import cli
+
+        for flags in (["--serve-deadline-ms", "0"],
+                      ["--serve-queue-depth", "0"],
+                      ["--serve-max-evictions", "0"],
+                      ["--serve-drain-ms", "-1"]):
+            with pytest.raises(SystemExit, match="fault policy"):
+                cli.main(flags)
+
+    def test_serve_fault_knobs_bridge_to_serve_config(self):
+        """The four fault-tolerance knobs flow CLI -> Config ->
+        ServeConfig.from_config, like the geometry knobs."""
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(
+            ["--serve-deadline-ms", "250", "--serve-queue-depth", "16",
+             "--serve-max-evictions", "3", "--serve-drain-ms", "500"])
+        c = cli.config_from_args(args)
+        s = ServeConfig.from_config(c)
+        assert (s.deadline_ms, s.queue_depth, s.max_evictions,
+                s.drain_ms) == (250.0, 16, 3, 500.0)
+        # defaults: every guard off, preserving pre-fault-layer behavior
+        s0 = ServeConfig.from_config(cli.config_from_args(
+            cli.build_parser().parse_args([])))
+        assert (s0.deadline_ms, s0.queue_depth, s0.max_evictions,
+                s0.drain_ms) == (None, None, None, None)
