@@ -407,7 +407,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     queue_depth: int | None = None,
                     max_evictions: int | None = None,
                     drain_ms: float | None = None,
-                    journal: str | None = None, tiny: bool = False) -> dict:
+                    journal: str | None = None, tiny: bool = False,
+                    kernel: str | None = None,
+                    kernel_ab: bool = False) -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic Poisson request trace.
 
@@ -437,6 +439,14 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     relaunch after SIGKILL provably resumes token-identically.  ``tiny``
     swaps BERT_TINY geometry in for the model — the smoke/CI
     configuration the fault-injection subprocess tests run.
+
+    ``kernel`` picks the paged-attention lowering (--serve-kernel:
+    auto|xla|pallas; None = the run Config's default).  The detail
+    reports the RESOLVED kernel plus a bytes-per-decode-token roofline
+    estimate for both lowerings.  ``kernel_ab`` additionally replays the
+    same trace through the OTHER kernel (own warmup, own zero-recompile
+    probe) and emits the speedup line — the control arm for validating
+    the fused kernel on real hardware.
     """
     import dataclasses as dc
     import time
@@ -486,9 +496,41 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         pool_blocks = max_slots * bps + 1
     serve = ServeConfig.from_config(
         cfg, num_blocks=pool_blocks, block_size=block_size,
-        max_slots=max_slots, max_seq_len=max_seq_len,
+        max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
+    if kernel_ab and journal is not None:
+        raise ValueError("--serve-kernel-ab is a measurement (two timed "
+                         "arms); the journaled serve mode is not — pick "
+                         "one")
+
+    def _roofline(resolved_kernel: str) -> dict:
+        """Bytes-per-decode-token ESTIMATE for both lowerings, from the
+        trace's own statistics: the XLA gather path touches the full
+        bucketed table width per token (pool read + view write + dense
+        attention read, K and V), the Pallas kernel streams one read of
+        the LIVE lanes.  A roofline, not a measurement — the label the
+        throughput number should be read against."""
+        dtype_bytes = jnp.dtype(cfg.compute_dtype).itemsize
+        row_bytes = bcfg.heads * bcfg.head_dim * dtype_bytes
+        # mean live context per decode token over the trace (position of
+        # token t of request i is len(prompt_i) + t)
+        ctx = [len(p) + t + 1 for p, o in zip(prompts, outputs)
+               for t in range(o)]
+        mean_ctx = float(np.mean(ctx))
+        cap = serve.max_blocks_per_seq * serve.block_size
+        per_layer = 2 * row_bytes                 # K and V
+        return {
+            "kernel": resolved_kernel,
+            "dtype_bytes": int(dtype_bytes),
+            "mean_live_context_tokens": round(mean_ctx, 1),
+            "padded_table_tokens": int(cap),
+            "bytes_per_decode_token_xla":
+                int(bcfg.layers * per_layer * cap * 3),
+            "bytes_per_decode_token_pallas":
+                int(bcfg.layers * per_layer * mean_ctx),
+            "xla_over_pallas_bytes": round(cap * 3 / mean_ctx, 1),
+        }
 
     def trace():
         return [Request(i, prompts[i], outputs[i], float(arrivals[i]))
@@ -510,6 +552,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                 trace(), journal_path=journal, guard=guard)
         return {
             "model": "gpt_tiny" if tiny else "gpt_base",
+            "kernel": res.get("kernel"),
+            "kernel_requested": kernel or cfg.serve_kernel,
+            "roofline": _roofline(res.get("kernel")),
             "serving_tokens_per_sec": res["tokens_per_sec"],
             "p50_token_latency_ms": res["p50_token_latency_ms"],
             "p99_token_latency_ms": res["p99_token_latency_ms"],
@@ -546,6 +591,49 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         cb = engine.run(trace(), guard=guard)
     steady_compiles = engine.compile_counts()
 
+    ab = None
+    if kernel_ab:
+        # the SAME trace through the other lowering: own engine, own
+        # untimed warmup (so both arms compare steady state), own
+        # zero-recompile probe — the kernel path must honor the bucket
+        # contract too, not just the gather path
+        other = "xla" if engine.kernel == "pallas" else "pallas"
+        if other == "pallas" and jax.default_backend() == "tpu":
+            # honor the compile probe / kill switch the auto path honors:
+            # a pallas arm the probe rejects would crash the whole bench
+            # after the timed arm instead of reporting it (off TPU the
+            # arm runs in interpret mode — slow but valid)
+            from mpi_tensorflow_tpu.ops import paged_attention_kernel
+            if not paged_attention_kernel.kernel_supported(
+                    jnp.dtype(bcfg.dtype).name, bcfg.heads, bcfg.head_dim,
+                    serve.block_size, serve.prefill_chunk):
+                other = None
+    if kernel_ab and other is None:
+        ab = {"skipped": "pallas kernel unsupported on this backend "
+                         "(compile probe failed or kill switch set); "
+                         "no control arm to compare against"}
+    elif kernel_ab:
+        eng2 = PagedDecodeEngine(
+            model, params, dc.replace(serve, kernel=other))
+        eng2.run(trace())
+        w2 = eng2.compile_counts()
+        eng2.reset()
+        cb2 = eng2.run(trace())
+        s2 = eng2.compile_counts()
+        arms = {engine.kernel: cb["tokens_per_sec"],
+                eng2.kernel: cb2["tokens_per_sec"]}
+        ab = {
+            "kernels": sorted(arms),
+            "tokens_per_sec": arms,
+            "pallas_speedup_vs_xla": (
+                round(arms["pallas"] / arms["xla"], 3)
+                if "pallas" in arms and "xla" in arms and arms["xla"] > 0
+                else None),
+            "ab_zero_recompile": (w2 == s2
+                                  if all(v is not None for v in
+                                         {**w2, **s2}.values()) else None),
+        }
+
     # -- static-batch baseline: generate() on arrival-order groups of
     # max_slots, each padded to its longest prompt and decoded to its
     # longest output budget, one shared cache capacity per batch --
@@ -581,6 +669,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
 
     return {
         "model": "gpt_tiny" if tiny else "gpt_base",
+        "kernel": engine.kernel,
+        "kernel_requested": kernel or cfg.serve_kernel,
+        "roofline": _roofline(engine.kernel),
+        "kernel_ab": ab,
         "serving_tokens_per_sec": cb["tokens_per_sec"],
         "p50_token_latency_ms": cb["p50_token_latency_ms"],
         "p99_token_latency_ms": cb["p99_token_latency_ms"],
@@ -899,6 +991,14 @@ def _stale_score(args, d: dict, item=None):
                         ("drain_ms", "serve_drain_ms")):
             if d.get(k) != getattr(args, attr, None):
                 return None
+        # the lowering shapes the number; an A/B request is two live
+        # arms by definition (absent keys on old records read as the
+        # pre-kernel default: the XLA gather path under "auto")
+        if getattr(args, "serve_kernel_ab", False) or d.get("kernel_ab"):
+            return None
+        if d.get("kernel_requested", "auto") != \
+                (getattr(args, "serve_kernel", None) or "auto"):
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1018,7 +1118,7 @@ def _report(args, d: dict, stale: bool = False) -> int:
     suffix = " [stale: last recorded TPU measurement]" if stale else ""
     if args.mode == "serving":
         sp = d.get("speedup_vs_static")
-        _print_json({
+        out = {
             "metric": f"GPT-base continuous-batching serving throughput "
                       f"(paged KV cache, Poisson trace){suffix}",
             "value": round(d["serving_tokens_per_sec"], 1),
@@ -1026,8 +1126,15 @@ def _report(args, d: dict, stale: bool = False) -> int:
             # >1 = continuous batching beats static-batch generate() on
             # the same trace (the in-run baseline arm)
             "vs_baseline": round(sp, 3) if sp else None,
+            # which paged-attention lowering served the timed arm
+            "kernel": d.get("kernel"),
             "detail": d,
-        })
+        }
+        ab = d.get("kernel_ab")
+        if ab is not None:
+            # THE speedup line the A/B flag exists for
+            out["kernel_speedup"] = ab.get("pallas_speedup_vs_xla")
+        _print_json(out)
         return 0
     if args.mode == "decode":
         kind = (f"beam-{args.num_beams}" if args.num_beams > 0 else "greedy")
@@ -1190,6 +1297,18 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-drain-ms", type=float, default=None,
                     help="serving mode: graceful-drain budget after "
                          "SIGTERM (default: finish all in-flight work)")
+    ap.add_argument("--serve-kernel", choices=["auto", "xla", "pallas"],
+                    default=None,
+                    help="serving mode: paged-attention lowering — auto "
+                         "(fused Pallas decode kernel on TPU when its "
+                         "compile probe passes, else the XLA gather "
+                         "path), or force one side (default: the run "
+                         "Config's serve_kernel)")
+    ap.add_argument("--serve-kernel-ab", action="store_true",
+                    help="serving mode: replay the same trace under "
+                         "BOTH kernels (each with its own warmup and "
+                         "zero-recompile probe) and emit the "
+                         "pallas-vs-xla speedup line")
     ap.add_argument("--serve-journal", default=None,
                     help="serving mode: fault-tolerant serve — journal "
                          "each request's prompt + generated prefix here "
@@ -1346,7 +1465,9 @@ def main(argv=None) -> int:
                             max_evictions=args.serve_max_evictions,
                             drain_ms=args.serve_drain_ms,
                             journal=args.serve_journal,
-                            tiny=args.serve_tiny)
+                            tiny=args.serve_tiny,
+                            kernel=args.serve_kernel,
+                            kernel_ab=args.serve_kernel_ab)
         return _report(args, r)
 
     if args.mode == "decode":

@@ -141,6 +141,13 @@ def build_parser() -> argparse.ArgumentParser:
                    default=d.serve_max_seq_len,
                    help="serving: per-request prompt+output cap (sizes "
                         "the per-sequence block table)")
+    p.add_argument("--serve-kernel", choices=["auto", "xla", "pallas"],
+                   default=d.serve_kernel,
+                   help="serving: paged-attention lowering — auto picks "
+                        "the fused Pallas decode kernel on TPU when its "
+                        "compile probe passes and the XLA gather path "
+                        "otherwise; xla/pallas force one side "
+                        "(ops/paged_attention.resolve_kernel)")
     p.add_argument("--serve-deadline-ms", type=float,
                    default=d.serve_deadline_ms,
                    help="serving: default per-request TTL from arrival; "
@@ -206,6 +213,7 @@ def config_from_args(args) -> Config:
         serve_block_size=args.serve_block_size,
         serve_max_slots=args.serve_max_slots,
         serve_max_seq_len=args.serve_max_seq_len,
+        serve_kernel=args.serve_kernel,
         serve_deadline_ms=args.serve_deadline_ms,
         serve_queue_depth=args.serve_queue_depth,
         serve_max_evictions=args.serve_max_evictions,

@@ -105,6 +105,12 @@ class Config:
     serve_max_slots: int = 8      # concurrent sequences (decode batch cap)
     serve_max_seq_len: int = 512  # per-request prompt+output cap; also
                                   # sizes the per-sequence block table
+    serve_kernel: str = "auto"    # paged-attention lowering: auto (fused
+                                  # Pallas kernel on TPU when the compile
+                                  # probe passes, else XLA gather), xla
+                                  # (force the exact gather fallback),
+                                  # pallas (force the kernel; interpret
+                                  # mode off TPU — the test path)
     # fault-tolerance policy (serving/engine.ServeConfig; None = off)
     serve_deadline_ms: Optional[float] = None  # default per-request TTL
                                   # from arrival; expired work fails

@@ -28,6 +28,7 @@ from jax import lax
 from mpi_tensorflow_tpu.models import bert as bert_lib
 from mpi_tensorflow_tpu.models import bert_pipeline
 from mpi_tensorflow_tpu.models.bert import _layernorm
+from mpi_tensorflow_tpu.ops import paged_attention as paged_ops
 from mpi_tensorflow_tpu.utils import engagement
 
 
@@ -120,7 +121,6 @@ class CausalLm(bert_lib.BertMlm):
         col = jnp.arange(L)
         # causal visibility over the cache: key position <= query position
         vis = col[None, :] <= pos[:, None]                 # (S_in, L)
-        scale = c.head_dim ** -0.5
 
         qkv_axes = ("batch", "heads", "seq", "head_dim")
         cache_axes = ("batch", "heads", "pos", "head_dim")
@@ -138,11 +138,11 @@ class CausalLm(bert_lib.BertMlm):
             ck = self._constrain(ck, cache_axes)
             cv = self._constrain(cv, cache_axes)
             new_cache.append({"k": ck, "v": cv})
-            s = jnp.einsum("bhsd,bhld->bhsl", q, ck).astype(jnp.float32)
-            s = jnp.where(vis[None, None], s * scale,
-                          jnp.finfo(jnp.float32).min)
-            p = jax.nn.softmax(s, axis=-1).astype(dt)
-            a = jnp.einsum("bhsl,bhld->bhsd", p, cv)
+            # the ONE fp32 masked-softmax implementation, shared with the
+            # paged path (ops/paged_attention) — token parity between the
+            # two holds by construction, not by review discipline
+            a = paged_ops.masked_softmax_attention(
+                q, ck, cv, vis[None, None], dt)
             a = bert_lib.attn_out_proj(lp, a, dt)
             h = _layernorm(h + a, lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))
@@ -160,7 +160,7 @@ class CausalLm(bert_lib.BertMlm):
         return logits.astype(jnp.float32), new_cache
 
     def forward_paged(self, params, tokens, pools, block_tables, lengths,
-                      valid=None):
+                      valid=None, kernel: str = "xla"):
         """Forward ``tokens`` (B, S_in) through the PAGED KV cache: row
         ``b`` occupies absolute positions [lengths[b], lengths[b]+S_in),
         reading/writing the per-layer block pools (serving/paged_cache)
@@ -170,7 +170,8 @@ class CausalLm(bert_lib.BertMlm):
         prefill+decode on the contiguous path.
 
         pools:        per-layer [{"k", "v"}] block pools, each
-                      (num_blocks, block_size, H, D)
+                      (num_blocks, H, block_size, D) — head-major,
+                      ops/paged_attention's layout
         block_tables: (B, NB) int32 pool block ids, position order;
                       entries beyond a row's allocation must be the null
                       block (0)
@@ -179,15 +180,22 @@ class CausalLm(bert_lib.BertMlm):
                       prefill tail, inactive decode slots) scatter into
                       the null block and their outputs are garbage the
                       caller discards
+        kernel:       "xla" (gather + dense masked softmax) or "pallas"
+                      (fused Pallas kernel streaming pool blocks in
+                      place) — a STATIC choice resolved host-side
+                      (ops/paged_attention.resolve_kernel); per-row
+                      ``lengths`` flow into the attention op either way,
+                      so the kernel can bound its block walk by live
+                      tokens instead of relying on the visibility mask
+                      alone
 
-        Returns (fp32 logits (B, S_in, V), updated pools).  The math is
-        kept in LOCKSTEP with ``forward_with_cache`` — same shared layer
-        helpers, same fp32 masked-softmax attention over a position-
-        ordered cache view — so greedy decode through this path is
-        token-identical to ``generate`` (pinned by tests/test_serving.py).
+        Returns (fp32 logits (B, S_in, V), updated pools).  The math
+        shares ``forward_with_cache``'s layers AND its attention
+        (``ops/paged_attention.masked_softmax_attention`` on the XLA
+        path; the Pallas kernel's online softmax is pinned against it by
+        tests/test_paged_kernel.py) — so greedy decode through this path
+        is token-identical to ``generate`` (tests/test_serving.py).
         """
-        from mpi_tensorflow_tpu.ops import paged_attention as paged_ops
-
         c = self.cfg
         dt = c.dtype
         B, S_in = tokens.shape
@@ -208,7 +216,7 @@ class CausalLm(bert_lib.BertMlm):
         h = self._constrain(h, ("batch", "seq", "embed"))
 
         qkv_axes = ("batch", "heads", "seq", "head_dim")
-        engagement.record("paged_attention", "gather")
+        engagement.record("paged_attention", kernel)
         new_pools = []
         for lp, pl in zip(params["layers"], pools):
             q, k, v = bert_lib.qkv_proj(lp, h, dt, fused=c.fused_qkv)
@@ -221,9 +229,8 @@ class CausalLm(bert_lib.BertMlm):
             pk = paged_ops.write_kv(pl["k"], k, block_tables, pos, valid)
             pv = paged_ops.write_kv(pl["v"], v, block_tables, pos, valid)
             new_pools.append({"k": pk, "v": pv})
-            ck = paged_ops.gather_kv(pk, block_tables)
-            cv = paged_ops.gather_kv(pv, block_tables)
-            a = paged_ops.paged_attention(q, ck, cv, pos, dt)
+            a = paged_ops.attend(q, pk, pv, block_tables, lengths, dt,
+                                 kernel=kernel)
             a = bert_lib.attn_out_proj(lp, a, dt)
             h = _layernorm(h + a, lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))

@@ -1,7 +1,7 @@
 """Paged KV-cache device ops: block-table gather/scatter + attention.
 
 The serving path (serving/) stores K/V in a fixed pool of
-``(num_blocks, block_size, heads, head_dim)`` blocks instead of one
+``(num_blocks, heads, block_size, head_dim)`` blocks instead of one
 contiguous ``(B, H, max_len, D)`` buffer per request batch
 (models/gpt.init_cache).  Each live sequence owns an ordered list of
 pool blocks (its block table); block ``j`` of a sequence holds absolute
@@ -9,18 +9,30 @@ positions ``[j*block_size, (j+1)*block_size)``, so a gather of the table
 reconstructs the contiguous layout and the attention math can stay
 IDENTICAL to the contiguous decode path — the token-parity guarantee
 (tests/test_serving.py) rests on that: same einsum contraction order,
-same fp32 masked softmax, with padding lanes exactly zeroed
+same fp32 masked softmax (``masked_softmax_attention``, the ONE
+implementation both paths call), with padding lanes exactly zeroed
 (``exp(finfo.min - max)`` underflows to 0.0, and 0-weighted V lanes add
 exact 0.0 terms).
+
+The pool layout is head-major so a single block is ``(H, block_size,
+D)`` — the orientation the fused Pallas kernel
+(ops/paged_attention_kernel) streams blockwise with no in-kernel
+transpose.
 
 Block 0 is the NULL block: never allocated to a sequence, it absorbs
 scatter writes from masked-out lanes (padded prefill tail, inactive
 decode slots) so those lanes need no branching — garbage lands in
 scratch, reads of it are masked by the causal visibility test.
 
-All ops are plain XLA gather/scatter + einsum (TPU-lowerable, CPU-exact
-for tests); a Pallas kernel can slot in behind ``paged_attention``
-without touching callers.
+``attend`` is THE dispatcher behind the paged-attention seam: the
+``--serve-kernel`` knob (CLI -> Config -> ServeConfig -> engine)
+resolves through ``resolve_kernel`` to either
+
+- ``pallas`` — the fused kernel, reading pool blocks in place through
+  the block table with an fp32 online softmax (TPU; ``interpret=True``
+  on CPU for tests), or
+- ``xla``    — this module's gather + dense masked softmax, the
+  always-available exact fallback (TPU-lowerable, CPU-exact).
 """
 
 from __future__ import annotations
@@ -31,10 +43,34 @@ import jax.numpy as jnp
 NULL_BLOCK = 0
 
 
+def masked_softmax_attention(q, k, v, vis, dt, scale=None):
+    """THE fp32 masked-softmax attention shared by the contiguous decode
+    path (models/gpt.forward_with_cache) and the paged path
+    (``paged_attention``) — one implementation, so the greedy
+    token-parity guarantee between them holds by construction.
+
+    q:    (B, H, S, D) queries
+    k, v: (B, H, L, D) position-ordered keys/values
+    vis:  bool, broadcastable to (B, S, L) — True where the key lane is
+          visible to the query row
+    dt:   compute dtype for the probability @ V contraction
+
+    Cast to fp32 BEFORE the scale, scale folded into the masked select,
+    softmax in fp32, probabilities cast back to ``dt``.  Masked lanes
+    score ``finfo(f32).min`` so their softmax weight underflows to
+    exact 0.0.
+    """
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    s = jnp.einsum("bhsd,bhld->bhsl", q, k).astype(jnp.float32)
+    s = jnp.where(vis, s * scale, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1).astype(dt)
+    return jnp.einsum("bhsl,bhld->bhsd", p, v)
+
+
 def write_kv(pool, kv, block_table, positions, valid):
     """Scatter per-token K or V vectors into the block pool.
 
-    pool:        (num_blocks, block_size, H, D)
+    pool:        (num_blocks, H, block_size, D)
     kv:          (B, H, S, D)  — new keys or values, head-major like the
                  qkv projection emits
     block_table: (B, NB) int32 — pool block ids, position order
@@ -45,14 +81,16 @@ def write_kv(pool, kv, block_table, positions, valid):
     (the allocator hands each block to one sequence); invalid lanes all
     land in block 0, whose contents are never read unmasked.
     """
-    bs = pool.shape[1]
+    bs = pool.shape[2]
     nb = block_table.shape[1]
     blk_idx = jnp.clip(positions // bs, 0, nb - 1)
     blk = jnp.take_along_axis(block_table, blk_idx, axis=1)      # (B, S)
     blk = jnp.where(valid, blk, NULL_BLOCK)
     off = positions % bs
     vals = jnp.transpose(kv, (0, 2, 1, 3))                       # (B, S, H, D)
-    return pool.at[blk, off].set(vals.astype(pool.dtype))
+    # two advanced indices around the head slice: the broadcast (B, S)
+    # index dims lead, so this writes pool[blk[b,s], h, off[b,s], :]
+    return pool.at[blk, :, off].set(vals.astype(pool.dtype))
 
 
 def gather_kv(pool, block_table):
@@ -63,9 +101,9 @@ def gather_kv(pool, block_table):
     visibility test against absolute query positions carries over
     unchanged from the contiguous path.
     """
-    g = pool[block_table]                        # (B, NB, bs, H, D)
-    B, NB, bs, H, D = g.shape
-    return jnp.transpose(g.reshape(B, NB * bs, H, D), (0, 2, 1, 3))
+    g = pool[block_table]                        # (B, NB, H, bs, D)
+    B, NB, H, bs, D = g.shape
+    return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(B, H, NB * bs, D)
 
 
 def paged_attention(q, ck, cv, q_positions, dt):
@@ -76,17 +114,79 @@ def paged_attention(q, ck, cv, q_positions, dt):
     q_positions: (B, S) absolute positions of the queries
     dt:          compute dtype for the probability @ V contraction
 
-    Math kept in LOCKSTEP with models/gpt.forward_with_cache (cast to
-    fp32 BEFORE the scale, scale folded into the masked select, softmax
-    in fp32, probabilities cast back to ``dt``): the greedy token-parity
-    test pins this path to the contiguous one bit-for-bit on CPU.
+    The math IS models/gpt.forward_with_cache's attention
+    (``masked_softmax_attention``): the greedy token-parity test pins
+    this path to the contiguous one bit-for-bit on CPU.
     """
     L = ck.shape[2]
-    scale = q.shape[-1] ** -0.5
     col = jnp.arange(L)
     # (B, S, L): key position <= query position, per row
     vis = col[None, None, :] <= q_positions[:, :, None]
-    s = jnp.einsum("bhsd,bhld->bhsl", q, ck).astype(jnp.float32)
-    s = jnp.where(vis[:, None], s * scale, jnp.finfo(jnp.float32).min)
-    p = jax.nn.softmax(s, axis=-1).astype(dt)
-    return jnp.einsum("bhsl,bhld->bhsd", p, cv)
+    return masked_softmax_attention(q, ck, cv, vis[:, None], dt)
+
+
+def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
+           kernel: str = "xla"):
+    """THE paged-attention dispatch seam: one entry point, two lowering
+    strategies, identical greedy tokens (tests/test_paged_kernel.py).
+
+    q:           (B, H, S, D) queries at positions [lengths[b],
+                 lengths[b] + S) — their K/V already scattered into the
+                 pools (write_kv runs first)
+    k/v_pool:    (num_blocks, H, block_size, D)
+    block_table: (B, NB) int32
+    lengths:     (B,) int32 cache entries already present per row
+    kernel:      "xla" (gather + dense masked softmax) or "pallas"
+                 (fused blockwise online softmax; interpret mode off
+                 TPU).  Callers resolve "auto" BEFORE tracing via
+                 ``resolve_kernel`` — this runs under jit, where the
+                 choice must be static.
+    """
+    if kernel == "pallas":
+        from mpi_tensorflow_tpu.ops import paged_attention_kernel as pk
+
+        interpret = jax.default_backend() != "tpu"
+        fused = (pk.paged_decode_attention if q.shape[2] == 1
+                 else pk.paged_prefill_attention)
+        return fused(q, k_pool, v_pool, block_table, lengths,
+                     interpret=interpret)
+    if kernel != "xla":
+        raise ValueError(
+            f"unresolved paged-attention kernel {kernel!r}: callers "
+            f"resolve 'auto' host-side via resolve_kernel before tracing")
+    S = q.shape[2]
+    pos = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)
+    ck = gather_kv(k_pool, block_table)
+    cv = gather_kv(v_pool, block_table)
+    return paged_attention(q, ck, cv, pos, dt)
+
+
+def resolve_kernel(choice: str, cfg, block_size: int,
+                   prefill_chunk: int = 64) -> str:
+    """Resolve the ``--serve-kernel`` knob to a static lowering choice.
+
+    - "xla"    -> "xla"     (always available, exact)
+    - "pallas" -> "pallas"  (forced; interpret mode off TPU — the test
+                             configuration)
+    - "auto"   -> "pallas" on TPU when the compile probe
+                  (paged_attention_kernel.kernel_supported) passes for
+                  this model geometry, else "xla".  Off TPU, "auto"
+                  stays on XLA: the interpreter is a correctness
+                  vehicle, not a serving path.
+
+    Host-side, once per engine: the resolved literal is baked into the
+    jitted decode/prefill steps, so kernel choice can never add dispatch
+    shapes or recompiles.
+    """
+    if choice in ("xla", "pallas"):
+        return choice
+    if choice != "auto":
+        raise ValueError(
+            f"serve kernel must be auto|xla|pallas, got {choice!r}")
+    if jax.default_backend() != "tpu":
+        return "xla"
+    from mpi_tensorflow_tpu.ops import paged_attention_kernel as pk
+
+    ok = pk.kernel_supported(jnp.dtype(cfg.dtype).name, cfg.heads,
+                             cfg.head_dim, block_size, prefill_chunk)
+    return "pallas" if ok else "xla"

@@ -1,0 +1,264 @@
+"""Fused Pallas paged-attention kernel — decode + chunked prefill.
+
+The XLA paged path (ops/paged_attention.gather_kv + paged_attention)
+materializes the whole padded contiguous KV view — two pool-sized
+copies per layer per decode token, then a dense masked softmax over the
+full bucketed table width.  This kernel reads the pool **blocks in
+place** through the block table with an fp32 online softmax (the
+PagedAttention / Flash-Decoding recipe, PAPERS.md): per grid step one
+``(H, block_size, D)`` K block and V block stream HBM->VMEM, scores and
+the running (m, l, acc) statistics stay in VMEM scratch, and the
+``(B, H, NB*block_size, D)`` gathered view never exists.
+
+Grid: ``(batch-slot, kv-block)``, kv-block innermost.  The block table
+rides in as a **scalar-prefetch** operand, so each step's BlockSpec
+index map picks the pool block to DMA (``bt[b, j]``) before the kernel
+body runs — the Pallas pipeline turns the host-side block table into
+device-side streamed reads with no gather materialization.
+
+Early-out: a sequence of length ``len_b`` only has
+``nlive = ceil((len_b + S) / block_size)`` live blocks.  Steps with
+``j >= nlive`` clamp their index map to the last live block — Pallas
+skips the DMA when the block index repeats — and ``pl.when`` skips the
+compute, so per-token cost tracks **live tokens**, not the padded NB
+bucket.
+
+Masking contract (kept in LOCKSTEP with ops/paged_attention.
+paged_attention — the parity suite in tests/test_paged_kernel.py pins
+it): a key lane at absolute position ``col = j*block_size + offset`` is
+visible iff ``col <= q_position``; invisible lanes score
+``finfo(f32).min`` so their softmax weight underflows to exact 0.0.
+Null-block (block 0) lanes and bucket-slack rows need no special
+branch: null blocks only back table entries past a row's allocation,
+whose positions the visibility test already rejects, and slack rows
+(all-null table, length 0) produce garbage the engine discards —
+exactly as on the XLA path.
+
+Pool layout is head-major — ``(num_blocks, H, block_size, D)`` — so a
+fetched block is ``(H, block_size, D)`` and both matmuls batch over H
+with no in-kernel transpose (the official TPU paged-attention kernels
+use the same orientation).
+
+``kernel_supported()`` gates the TPU path behind a real compile probe
+(toolchain regressions degrade to the XLA gather path);
+``interpret=True`` runs the same kernel on CPU for the tier-1 parity
+suite.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# stats rows are lane-broadcast to the f32 tile width, mirroring
+# ops/flash_attention's LSE_LANES treatment of per-row statistics
+STAT_LANES = 128
+
+
+def _nlive(length, S: int, bs: int, NB: int):
+    """Live block count for a row: lanes up to ``length + S`` hold real
+    cache entries (the step's own tokens were scattered in by write_kv
+    before attention), everything past them is null-block padding."""
+    return jnp.clip((length + S + bs - 1) // bs, 1, NB)
+
+
+def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc, m_scr, l_scr, *, scale: float, block_size: int):
+    """One (batch-slot, kv-block) grid step of the online softmax.
+
+    q_ref:  (1, H, S, D)   — the row's whole query block (revisited)
+    k_ref:  (1, H, bs, D)  — pool block ``bt[b, min(j, nlive-1)]``
+    v_ref:  (1, H, bs, D)
+    o_ref:  (1, H, S, D)   — written once, at the last LIVE block
+    scratch: acc (H, S, D) f32, m/l (H, S, STAT_LANES) f32
+    """
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    NB = pl.num_programs(1)
+    H, S, D = q_ref.shape[1:]
+    bs = block_size
+    nlive = _nlive(len_ref[b], S, bs, NB)
+
+    @pl.when(j == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, jnp.finfo(jnp.float32).min)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(j < nlive)
+    def _step():
+        q = q_ref[0]                                   # (H, S, D)
+        k = k_ref[0]                                   # (H, bs, D)
+        v = v_ref[0]
+        s = lax.dot_general(
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # (H, S, bs)
+        # visibility: key position <= query position, exactly the XLA
+        # path's mask (q positions are lengths[b] + [0, S))
+        col = j * bs + lax.broadcasted_iota(jnp.int32, (S, bs), 1)
+        qpos = len_ref[b] + lax.broadcasted_iota(jnp.int32, (S, bs), 0)
+        s = jnp.where((col <= qpos)[None], s * scale,
+                      jnp.finfo(jnp.float32).min)
+        m_prev = m_scr[:, :, 0:1]                      # (H, S, 1)
+        l_prev = l_scr[:, :, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                         # (H, S, bs)
+        corr = jnp.exp(m_prev - m_new)                 # (H, S, 1)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc[:] = acc[:] * corr + lax.dot_general(
+            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)        # (H, S, D)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(j == nlive - 1)
+    def _emit():
+        l = l_scr[:, :, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc[:] / l_safe).astype(o_ref.dtype)
+
+
+def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
+                scale: float, interpret: bool):
+    B, H, S, D = q.shape
+    NB = block_table.shape[1]
+    bs = k_pool.shape[2]
+
+    def kv_map(b, j, bt, lens):
+        # clamp dead steps to the last live block: the repeated index
+        # makes the Pallas pipeline skip the refetch, so padded table
+        # width costs no HBM traffic
+        jl = jnp.minimum(j, _nlive(lens[b], S, bs, NB) - 1)
+        return (bt[b, jl], 0, 0, 0)
+
+    def q_map(b, j, bt, lens):
+        return (b, 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NB),
+        in_specs=[
+            pl.BlockSpec((1, H, S, D), q_map),
+            pl.BlockSpec((1, H, bs, D), kv_map),
+            pl.BlockSpec((1, H, bs, D), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, H, S, D), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((H, S, D), jnp.float32),
+            pltpu.VMEM((H, S, STAT_LANES), jnp.float32),
+            pltpu.VMEM((H, S, STAT_LANES), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_kernel, scale=scale, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
+        interpret=interpret,
+    )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
+      q, k_pool, v_pool)
+
+
+def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
+                           scale=None, interpret: bool = False):
+    """Fused paged attention over pool blocks — no gathered view.
+
+    q:           (B, H, S, D) queries; S=1 decode, S=chunk prefill
+    k_pool:      (num_blocks, H, block_size, D) key pool (head-major,
+                 ops/paged_attention.write_kv layout)
+    v_pool:      idem, values
+    block_table: (B, NB) int32 pool block ids, position order; entries
+                 past a row's allocation must be the null block (0)
+    lengths:     (B,) int32 cache entries already present per row; the
+                 queries occupy absolute positions
+                 [lengths[b], lengths[b] + S) and their K/V must already
+                 be scattered into the pool (write_kv runs first)
+
+    Returns (B, H, S, D) in q.dtype.  Numerically this is the online-
+    softmax evaluation of ops/paged_attention.paged_attention over the
+    gathered view — token-parity on the greedy decode path is pinned by
+    tests/test_paged_kernel.py.
+    """
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    return _paged_call(q, k_pool, v_pool, block_table, lengths,
+                       scale=scale, interpret=interpret)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_table, lengths, *,
+                           scale=None, interpret: bool = False):
+    """Single-token decode specialization (S must be 1) — the serving
+    hot path.  Thin wrapper so call sites (and probes) name the phase
+    they are on; the grid/kernel body is shared with chunked prefill."""
+    if q.shape[2] != 1:
+        raise ValueError(f"decode takes one query token per row, got "
+                         f"S={q.shape[2]} (use paged_prefill_attention)")
+    return paged_attention_kernel(q, k_pool, v_pool, block_table,
+                                  lengths, scale=scale,
+                                  interpret=interpret)
+
+
+def paged_prefill_attention(q, k_pool, v_pool, block_table, lengths, *,
+                            scale=None, interpret: bool = False):
+    """Chunked-prefill variant: S = chunk queries per row at positions
+    [lengths[b], lengths[b] + S), causal within the chunk and over the
+    cache via the same visibility test (col <= q position)."""
+    return paged_attention_kernel(q, k_pool, v_pool, block_table,
+                                  lengths, scale=scale,
+                                  interpret=interpret)
+
+
+@functools.lru_cache(maxsize=16)
+def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
+                     head_dim: int = 64, block_size: int = 16,
+                     prefill_chunk: int = 64) -> bool:
+    """One-time probe per geometry: do the decode AND prefill kernels
+    compile for this backend's Mosaic?  The serving dispatcher gates
+    ``--serve-kernel auto`` on this (passing the dtype/heads/head_dim/
+    block_size/prefill_chunk it will actually run) so a toolchain
+    regression degrades to the XLA gather path instead of killing the
+    engine.  The probe compiles decode (S=1) plus EVERY pow2 prefill
+    bucket up to ``prefill_chunk`` — the exact S set the engine
+    dispatches (engine._bucket), since S changes the kernel's tile
+    shapes.  (Grid extents B/NB vary per dispatch too, but only as grid
+    bounds and scalar-table width, not tile shapes — the fixed B=8/NB=4
+    probe stands in for them.)  Mirrors
+    ops/flash_attention.kernel_supported, including the operator kill
+    switch: ``MPI_TF_TPU_DISABLE_PAGED_KERNEL=1`` force-disables the
+    kernel (also the control arm for kernel A/B benches).  Checked
+    inside the cached body, so it must be set before first use."""
+    import os as _os
+    import sys as _sys
+
+    try:
+        if _os.environ.get("MPI_TF_TPU_DISABLE_PAGED_KERNEL", "") \
+                not in ("", "0"):
+            print("[paged_attention_kernel] disabled via "
+                  "MPI_TF_TPU_DISABLE_PAGED_KERNEL", file=_sys.stderr)
+            return False
+        if jax.devices()[0].platform != "tpu":
+            return False
+        dt = jnp.dtype(dtype_name)
+        B, NB, bs = 8, 4, block_size
+        pool = jnp.zeros((1 + B * NB, heads, bs, head_dim), dt)
+        bt = jnp.arange(1, 1 + B * NB, dtype=jnp.int32).reshape(B, NB)
+        lens = jnp.full((B,), bs, jnp.int32)
+        chunks = []                       # 1 (decode) + pow2 buckets
+        S = 1
+        while S <= prefill_chunk:
+            chunks.append(S)
+            S *= 2
+        for S in chunks:
+            q = jnp.zeros((B, heads, S, head_dim), dt)
+            jax.jit(paged_attention_kernel).lower(
+                q, pool, pool, bt, lens).compile()
+        return True
+    except Exception as e:   # noqa: BLE001 — any compile failure disables
+        print(f"[paged_attention_kernel] Pallas probe failed for "
+              f"{dtype_name} (H={heads}, D={head_dim}, bs={block_size}); "
+              f"falling back to the XLA gather path ({e!r})",
+              file=_sys.stderr)
+        return False

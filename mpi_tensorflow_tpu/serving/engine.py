@@ -44,6 +44,11 @@ class ServeConfig:
     eos_id: Optional[int] = None  # emit-EOS slot recycling (None: budget
                                   # exhaustion only — the LM families
                                   # train on streams with no terminator)
+    kernel: str = "auto"          # paged-attention lowering: auto | xla
+                                  # | pallas (--serve-kernel; resolved
+                                  # ONCE at engine construction via
+                                  # ops/paged_attention.resolve_kernel,
+                                  # so the choice is static under jit)
     # --- fault-tolerance policy (None = feature off / unbounded) ---
     deadline_ms: Optional[float] = None   # default per-request TTL from
                                   # arrival; expired work fails with
@@ -71,6 +76,7 @@ class ServeConfig:
                     block_size=config.serve_block_size,
                     max_slots=config.serve_max_slots,
                     max_seq_len=config.serve_max_seq_len,
+                    kernel=config.serve_kernel,
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
                     max_evictions=config.serve_max_evictions,
@@ -87,6 +93,10 @@ class ServeConfig:
                 or self.prefill_chunk < 1 or self.max_slots < 1 \
                 or self.max_seq_len < 1:
             raise ValueError(f"bad pool geometry: {self}")
+        if self.kernel not in ("auto", "xla", "pallas"):
+            raise ValueError(
+                f"serve kernel must be auto|xla|pallas, "
+                f"got {self.kernel!r}")
         if (self.deadline_ms is not None and self.deadline_ms <= 0) \
                 or (self.queue_depth is not None and self.queue_depth < 1) \
                 or (self.max_evictions is not None
@@ -129,6 +139,8 @@ class PagedDecodeEngine:
     def __init__(self, model, params, serve: ServeConfig):
         import jax
 
+        from mpi_tensorflow_tpu.ops import paged_attention as paged_ops
+
         self.model = model
         self.params = params
         self.serve = serve
@@ -138,6 +150,13 @@ class PagedDecodeEngine:
             raise ValueError(
                 f"max_seq_len {serve.max_seq_len} (table capacity {cap}) "
                 f"exceeds max_positions {model.cfg.max_positions}")
+        # resolve auto -> xla|pallas ONCE, host-side: the literal bakes
+        # into the jitted steps below, so kernel choice cannot add
+        # dispatch shapes or recompiles (the zero-recompile contract
+        # covers the kernel path by construction)
+        self.kernel = paged_ops.resolve_kernel(
+            serve.kernel, model.cfg, serve.block_size,
+            serve.prefill_chunk)
         # donate the pools so the TPU cache updates in place; CPU (the
         # test platform) does not implement donation — skip the arg to
         # keep the suite free of spurious donation warnings
@@ -186,7 +205,8 @@ class PagedDecodeEngine:
 
         live = (tables[:, 0] != NULL_BLOCK)[:, None]
         logits, pools = self.model.forward_paged(
-            params, tokens[:, None], pools, tables, lengths, valid=live)
+            params, tokens[:, None], pools, tables, lengths, valid=live,
+            kernel=self.kernel)
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         return nxt, pools
 
@@ -199,7 +219,8 @@ class PagedDecodeEngine:
         S = tokens.shape[1]
         valid = jnp.arange(S)[None] < n_real
         logits, pools = self.model.forward_paged(
-            params, tokens, pools, tables, length[None], valid=valid)
+            params, tokens, pools, tables, length[None], valid=valid,
+            kernel=self.kernel)
         nxt = jnp.argmax(logits[0, jnp.maximum(n_real - 1, 0)], axis=-1)
         return nxt.astype(jnp.int32), pools
 
@@ -439,6 +460,7 @@ class PagedDecodeEngine:
                 "cut": int(self.sched.counters["drained"]),
                 "budget_ms": serve.drain_ms,
             },
+            "kernel": self.kernel,
             "tokens": total,
             "elapsed_s": elapsed,
             "tokens_per_sec": total / elapsed if elapsed > 0 else 0.0,

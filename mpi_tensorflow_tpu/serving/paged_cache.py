@@ -1,8 +1,10 @@
 """Paged KV cache: host block allocator + device pool construction.
 
-The device side is a fixed pool of ``(num_blocks, block_size, heads,
+The device side is a fixed pool of ``(num_blocks, heads, block_size,
 head_dim)`` K and V blocks per transformer layer (ops/paged_attention
-reads/writes it through per-sequence block tables).  The host side —
+reads/writes it through per-sequence block tables; the head-major
+layout lets the fused Pallas kernel stream whole ``(H, block_size, D)``
+blocks with no transpose).  The host side —
 this module — owns WHICH block belongs to WHOM: a free-list allocator
 whose accounting the scheduler's admit/evict decisions hang off.
 
@@ -79,6 +81,6 @@ def init_pools(cfg, num_blocks: int, block_size: int) -> list:
     threads them through jit the same way."""
     import jax.numpy as jnp
 
-    z = jnp.zeros((num_blocks, block_size, cfg.heads, cfg.head_dim),
+    z = jnp.zeros((num_blocks, cfg.heads, block_size, cfg.head_dim),
                   cfg.dtype)
     return [{"k": z, "v": z} for _ in range(cfg.layers)]

@@ -338,8 +338,39 @@ class TestMeasureServing:
         assert r["speedup_vs_static"] > 0
         assert r["zero_recompile_steady_state"], r
         assert r["p99_token_latency_ms"] >= r["p50_token_latency_ms"]
-        assert r["paths"].get("paged_attention") == "gather"
+        # engagement records the RESOLVED lowering (auto on CPU -> xla)
+        assert r["paths"].get("paged_attention") == "xla"
+        assert r["kernel"] == "xla" and r["kernel_requested"] == "auto"
+        roof = r["roofline"]
+        assert roof["bytes_per_decode_token_xla"] > \
+            roof["bytes_per_decode_token_pallas"] > 0
+        assert r["kernel_ab"] is None        # not requested
         assert r["tokens"] == 3 * 8          # every budget fully served
+
+    def test_serving_kernel_ab_emits_speedup(self, monkeypatch):
+        """--serve-kernel-ab: the same trace through both lowerings
+        (pallas in interpret mode on CPU), each zero-recompile after
+        its own warmup, and the speedup line present."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_serving(num_requests=2, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=4,
+                                  precision="fp32", kernel="xla",
+                                  kernel_ab=True)
+        ab = r["kernel_ab"]
+        assert ab["kernels"] == ["pallas", "xla"]
+        assert ab["tokens_per_sec"]["pallas"] > 0
+        assert ab["tokens_per_sec"]["xla"] > 0
+        assert ab["pallas_speedup_vs_xla"] is not None
+        assert ab["ab_zero_recompile"], ab
+
+    def test_serving_kernel_ab_rejects_journal_mode(self, tmp_path):
+        with pytest.raises(ValueError, match="kernel-ab"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  journal=str(tmp_path / "j.jsonl"),
+                                  kernel_ab=True)
 
 
 class TestHostIo:
