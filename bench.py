@@ -409,7 +409,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     drain_ms: float | None = None,
                     journal: str | None = None, tiny: bool = False,
                     kernel: str | None = None,
-                    kernel_ab: bool = False) -> dict:
+                    kernel_ab: bool = False,
+                    prefix_cache: str | None = None,
+                    prefix_tokens: int = 0) -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic Poisson request trace.
 
@@ -447,6 +449,16 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     same trace through the OTHER kernel (own warmup, own zero-recompile
     probe) and emits the speedup line — the control arm for validating
     the fused kernel on real hardware.
+
+    Prefix sharing: ``prefix_tokens > 0`` prepends a common N-token
+    system prompt to every request (the shared-prefix production
+    regime); ``prefix_cache`` (--serve-prefix-cache: off|on; None = the
+    run Config's default) turns the radix prefix cache on for the timed
+    arm.  With the cache on (and no journal), the SAME trace is also
+    replayed through a cache-OFF engine so the detail's ``prefix``
+    block carries the measurable win — ``hit_rate``, blocks saved, and
+    the pool-occupancy delta — plus a token-identity cross-check
+    against the unshared arm.
     """
     import dataclasses as dc
     import time
@@ -469,6 +481,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             f"serving trace needs >= 1 request/prompt/output token, got "
             f"requests={num_requests} prompt_max={prompt_max} "
             f"output_max={output_max}")
+    if prefix_tokens < 0:
+        raise ValueError(
+            f"--serve-prefix-tokens must be >= 0, got {prefix_tokens}")
     cfg = Config(precision=precision)
     # unset knobs resolve through the run Config's --serve-* defaults
     # (the one meaning of those knobs — serving.ServeConfig.from_config)
@@ -481,7 +496,16 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     params = model.init(jax.random.key(0))
     rng = np.random.default_rng(seed)
     p_lo, o_lo = min(8, prompt_max), min(8, output_max)
-    prompts = [list(map(int, rng.integers(0, bcfg.vocab_size, int(n))))
+    # shared-prefix workload: one common N-token system prompt replayed
+    # in front of every request's unique tail (prefix_tokens=0 keeps
+    # the original all-unique trace byte-for-byte)
+    shared = (list(map(int, rng.integers(0, bcfg.vocab_size,
+                                         prefix_tokens)))
+              if prefix_tokens else [])   # 0: do not advance the rng —
+                                          # the no-prefix trace must stay
+                                          # byte-for-byte the historical one
+    prompts = [shared + list(map(int, rng.integers(0, bcfg.vocab_size,
+                                                   int(n))))
                for n in rng.integers(p_lo, prompt_max + 1, num_requests)]
     outputs = [int(n) for n in rng.integers(o_lo, output_max + 1,
                                             num_requests)]
@@ -497,12 +521,18 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     serve = ServeConfig.from_config(
         cfg, num_blocks=pool_blocks, block_size=block_size,
         max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
+        prefix_cache=prefix_cache,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
     if kernel_ab and journal is not None:
         raise ValueError("--serve-kernel-ab is a measurement (two timed "
                          "arms); the journaled serve mode is not — pick "
                          "one")
+    if kernel_ab and serve.prefix_cache == "on":
+        raise ValueError("--serve-prefix-cache on adds its own cache-off "
+                         "control arm; combining it with "
+                         "--serve-kernel-ab would change two variables "
+                         "in one comparison — pick one")
 
     def _roofline(resolved_kernel: str) -> dict:
         """Bytes-per-decode-token ESTIMATE for both lowerings, from the
@@ -555,6 +585,11 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "kernel": res.get("kernel"),
             "kernel_requested": kernel or cfg.serve_kernel,
             "roofline": _roofline(res.get("kernel")),
+            "prefix": res.get("prefix"),
+            "serve_prefix_cache": serve.prefix_cache,
+            "serve_prefix_tokens": prefix_tokens,
+            "peak_blocks_in_use": res.get("peak_blocks_in_use"),
+            "peak_live_blocks": res.get("peak_live_blocks"),
             "serving_tokens_per_sec": res["tokens_per_sec"],
             "p50_token_latency_ms": res["p50_token_latency_ms"],
             "p99_token_latency_ms": res["p99_token_latency_ms"],
@@ -634,6 +669,36 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                                          {**w2, **s2}.values()) else None),
         }
 
+    prefix_detail = cb["prefix"]
+    if serve.prefix_cache == "on":
+        # the cache-off control arm: SAME trace, sharing disabled — the
+        # measurable win is its occupancy delta (blocks the trie saved)
+        # and it doubles as a token-identity cross-check (greedy decode
+        # must not notice the cache).  Not on the throughput line, but
+        # it still pays its compiles in an untimed warmup first (like
+        # the kernel A/B arm): a cold engine's compile stalls shift the
+        # trace's wall clock, which would skew deadline/shed outcomes
+        # and the occupancy comparison against the warmed cache-on arm
+        eng_off = PagedDecodeEngine(
+            model, params, dc.replace(serve, prefix_cache="off"))
+        eng_off.run(trace())
+        eng_off.reset()
+        off = eng_off.run(trace())
+        prefix_detail = {
+            **cb["prefix"],
+            # live = distinct blocks pinned by in-flight sequences (the
+            # occupancy that gates admission; trie-retained blocks are
+            # reclaimable cache and excluded).  THE acceptance number:
+            # sharing must put the cache-on run strictly below off
+            "peak_live_blocks": cb["peak_live_blocks"],
+            "peak_live_blocks_off": off["peak_live_blocks"],
+            "blocks_saved_peak": (off["peak_live_blocks"]
+                                  - cb["peak_live_blocks"]),
+            "peak_blocks_in_use": cb["peak_blocks_in_use"],
+            "peak_blocks_in_use_off": off["peak_blocks_in_use"],
+            "token_identical_vs_off": off["outputs"] == cb["outputs"],
+        }
+
     # -- static-batch baseline: generate() on arrival-order groups of
     # max_slots, each padded to its longest prompt and decoded to its
     # longest output budget, one shared cache capacity per batch --
@@ -673,6 +738,11 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "kernel_requested": kernel or cfg.serve_kernel,
         "roofline": _roofline(engine.kernel),
         "kernel_ab": ab,
+        "prefix": prefix_detail,
+        "serve_prefix_cache": serve.prefix_cache,
+        "serve_prefix_tokens": prefix_tokens,
+        "peak_blocks_in_use": cb["peak_blocks_in_use"],
+        "peak_live_blocks": cb["peak_live_blocks"],
         "serving_tokens_per_sec": cb["tokens_per_sec"],
         "p50_token_latency_ms": cb["p50_token_latency_ms"],
         "p99_token_latency_ms": cb["p99_token_latency_ms"],
@@ -999,6 +1069,17 @@ def _stale_score(args, d: dict, item=None):
         if d.get("kernel_requested", "auto") != \
                 (getattr(args, "serve_kernel", None) or "auto"):
             return None
+        # prefix sharing changes both the trace (the shared system
+        # prompt) and the pool behavior — a record measured under a
+        # different prefix config is a different number (absent keys on
+        # old records read as the pre-prefix defaults: 0 tokens, off)
+        if d.get("serve_prefix_tokens", 0) != \
+                getattr(args, "serve_prefix_tokens", 0):
+            return None
+        if d.get("serve_prefix_cache", "off") != \
+                (getattr(args, "serve_prefix_cache", None)
+                 or serve_defaults.serve_prefix_cache):
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1134,6 +1215,12 @@ def _report(args, d: dict, stale: bool = False) -> int:
         if ab is not None:
             # THE speedup line the A/B flag exists for
             out["kernel_speedup"] = ab.get("pallas_speedup_vs_xla")
+        pref = d.get("prefix")
+        if pref and pref.get("enabled"):
+            # the two numbers the prefix cache exists for: reuse rate
+            # and the pool occupancy it saved vs the cache-off arm
+            out["prefix_hit_rate"] = pref.get("hit_rate")
+            out["prefix_blocks_saved"] = pref.get("blocks_saved_peak")
         _print_json(out)
         return 0
     if args.mode == "decode":
@@ -1316,6 +1403,20 @@ def main(argv=None) -> int:
                          "run crashed), resume by replaying live "
                          "sequences token-identically.  Skips the "
                          "warmup replay and the static-batch arm")
+    ap.add_argument("--serve-prefix-cache", choices=["off", "on"],
+                    default=None,
+                    help="serving mode: radix prefix cache — on shares "
+                         "cached full prompt blocks across requests "
+                         "(refcounted, copy-on-write) and ALSO replays "
+                         "the trace through a cache-off control arm for "
+                         "the occupancy delta (default: the run "
+                         "Config's serve_prefix_cache)")
+    ap.add_argument("--serve-prefix-tokens", type=int, default=0,
+                    help="serving mode: prepend one common N-token "
+                         "system prompt to every request — the shared-"
+                         "prefix workload the prefix cache exists for "
+                         "(0 = all-unique prompts, the historical "
+                         "trace)")
     ap.add_argument("--serve-tiny", action="store_true",
                     help="serving mode: BERT_TINY model geometry — the "
                          "smoke/fault-injection configuration, not a "
@@ -1396,6 +1497,17 @@ def main(argv=None) -> int:
                            ("bert_base", "moe_bert", "gpt_base", "encdec_t5")):
         ap.error("--fused-qkv applies to the transformer families in train "
                  "mode only — other paths would silently ignore it")
+    if args.serve_prefix_tokens < 0:
+        ap.error(f"--serve-prefix-tokens must be >= 0, got "
+                 f"{args.serve_prefix_tokens}")
+    if (args.serve_prefix_tokens or args.serve_prefix_cache is not None) \
+            and args.mode != "serving":
+        ap.error("--serve-prefix-cache/--serve-prefix-tokens shape the "
+                 "serving trace; other modes would silently ignore them")
+    if args.serve_prefix_cache == "on" and args.serve_kernel_ab:
+        ap.error("--serve-prefix-cache on already adds its own cache-off "
+                 "control arm; combine with --serve-kernel-ab one at a "
+                 "time so each comparison has a single variable")
     if args.prng != "threefry" and args.mode != "train":
         ap.error("--prng shapes the training dropout stream; decode/"
                  "allreduce modes have no dropout and would silently "
@@ -1467,7 +1579,9 @@ def main(argv=None) -> int:
                             journal=args.serve_journal,
                             tiny=args.serve_tiny,
                             kernel=args.serve_kernel,
-                            kernel_ab=args.serve_kernel_ab)
+                            kernel_ab=args.serve_kernel_ab,
+                            prefix_cache=args.serve_prefix_cache,
+                            prefix_tokens=args.serve_prefix_tokens)
         return _report(args, r)
 
     if args.mode == "decode":

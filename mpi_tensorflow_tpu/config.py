@@ -111,6 +111,12 @@ class Config:
                                   # (force the exact gather fallback),
                                   # pallas (force the kernel; interpret
                                   # mode off TPU — the test path)
+    serve_prefix_cache: str = "off"  # radix prefix cache: "on" shares
+                                  # already-cached full prompt blocks
+                                  # across requests (refcounted, copy-
+                                  # on-write, LRU trie eviction under
+                                  # pool pressure); "off" preserves the
+                                  # unshared behavior byte-for-byte
     # fault-tolerance policy (serving/engine.ServeConfig; None = off)
     serve_deadline_ms: Optional[float] = None  # default per-request TTL
                                   # from arrival; expired work fails

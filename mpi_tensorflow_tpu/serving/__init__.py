@@ -2,9 +2,16 @@
 
 Four cooperating layers, host-side policy over device-side math:
 
-- ``paged_cache``  — fixed device pool of KV blocks + the host block
-                     allocator; memory scales with LIVE tokens, not
-                     ``batch x max_len`` (vs models/gpt.init_cache).
+- ``paged_cache``  — fixed device pool of KV blocks + the refcounted
+                     host block allocator; memory scales with LIVE
+                     tokens, not ``batch x max_len`` (vs
+                     models/gpt.init_cache), and refcounts let one
+                     physical block back many sequences.
+- ``prefix_cache`` — radix trie over full prompt blocks (RadixAttention
+                     lineage): new requests map already-cached prefix
+                     blocks instead of recomputing them, with
+                     copy-on-write on divergence and LRU eviction of
+                     unreferenced entries under pool pressure.
 - ``scheduler``    — request queue, admit-on-free-blocks, per-step slot
                      recycling on EOS/budget, eviction under pressure;
                      admission control (feasibility check, bounded
@@ -30,6 +37,8 @@ from mpi_tensorflow_tpu.serving.engine import (  # noqa: F401
     PagedDecodeEngine, ServeConfig)
 from mpi_tensorflow_tpu.serving.paged_cache import (  # noqa: F401
     BlockAllocator, init_pools)
+from mpi_tensorflow_tpu.serving.prefix_cache import (  # noqa: F401
+    PrefixCache)
 from mpi_tensorflow_tpu.serving.recovery import (  # noqa: F401
     ReplayJournal, run_with_replay)
 from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401

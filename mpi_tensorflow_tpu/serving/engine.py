@@ -19,6 +19,16 @@ bucketed shapes —
 (pinned by tests/test_serving.py via the jit cache-size probe).  The
 block pools are donated through every dispatch on TPU, so the cache
 updates in place instead of ping-ponging two pool-sized buffers.
+
+Prefix sharing (``--serve-prefix-cache on``): admission walks each
+prompt through a radix trie of cached full blocks
+(serving/prefix_cache) and maps hits to EXISTING physical blocks, so
+prefill computes only the unique suffix; the engine contributes the
+device half — a copy-on-write block copy before any dispatch would
+write into a shared block, and trie registration when a prompt finishes
+prefill.  Greedy outputs with the cache on are token-identical to
+cache-off for every request (the determinism contract the serving tests
+pin).
 """
 
 from __future__ import annotations
@@ -49,6 +59,13 @@ class ServeConfig:
                                   # ONCE at engine construction via
                                   # ops/paged_attention.resolve_kernel,
                                   # so the choice is static under jit)
+    prefix_cache: str = "off"     # radix prefix cache (--serve-prefix-
+                                  # cache): "on" maps cached full prompt
+                                  # blocks into new sequences (shared,
+                                  # copy-on-write on divergence, LRU
+                                  # trie eviction under pressure);
+                                  # "off" preserves byte-for-byte the
+                                  # unshared behavior
     # --- fault-tolerance policy (None = feature off / unbounded) ---
     deadline_ms: Optional[float] = None   # default per-request TTL from
                                   # arrival; expired work fails with
@@ -77,6 +94,7 @@ class ServeConfig:
                     max_slots=config.serve_max_slots,
                     max_seq_len=config.serve_max_seq_len,
                     kernel=config.serve_kernel,
+                    prefix_cache=config.serve_prefix_cache,
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
                     max_evictions=config.serve_max_evictions,
@@ -97,6 +115,10 @@ class ServeConfig:
             raise ValueError(
                 f"serve kernel must be auto|xla|pallas, "
                 f"got {self.kernel!r}")
+        if self.prefix_cache not in ("off", "on"):
+            raise ValueError(
+                f"serve prefix cache must be off|on, "
+                f"got {self.prefix_cache!r}")
         if (self.deadline_ms is not None and self.deadline_ms <= 0) \
                 or (self.queue_depth is not None and self.queue_depth < 1) \
                 or (self.max_evictions is not None
@@ -164,20 +186,51 @@ class PagedDecodeEngine:
         self._decode_fn = jax.jit(self._decode_impl, donate_argnums=donate)
         self._prefill_fn = jax.jit(self._prefill_impl,
                                    donate_argnums=donate)
+        # copy-on-write block copy: pools in, pools out, fixed shapes —
+        # exactly ONE compile ever (block ids ride as traced scalars)
+        self._cow_fn = jax.jit(
+            self._cow_impl,
+            donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
         self.reset()
+        if self.prefix_cache is not None:
+            # pre-pay the CoW copy's single compile with a null-block
+            # self-copy (a no-op write), so the first real CoW inside a
+            # timed steady-state window can never register as a
+            # recompile against the zero-recompile contract
+            import jax.numpy as jnp
+
+            z = jnp.asarray(0, jnp.int32)
+            self.pools = self._cow_fn(self.pools, z, z)
 
     def reset(self) -> None:
         """Fresh pools/scheduler; jit caches (and their warmed bucket
         shapes) survive — the bench harness times a second trace replay
         against exactly the compiles the first replay paid for."""
+        from mpi_tensorflow_tpu.serving import prefix_cache as prefix_lib
+
         self.pools = paged_cache.init_pools(
             self.model.cfg, self.serve.num_blocks, self.serve.block_size)
         self.allocator = paged_cache.BlockAllocator(self.serve.num_blocks)
+        # fresh trie with fresh pools: cached content lives in the pool,
+        # so the two reset together (a stale trie would map new
+        # sequences onto zeroed blocks)
+        self.prefix_cache = (
+            prefix_lib.PrefixCache(self.allocator, self.serve.block_size)
+            if self.serve.prefix_cache == "on" else None)
         self.sched = sched_lib.Scheduler(
             self.allocator, self.serve.max_slots, self.serve.block_size,
             self.serve.max_blocks_per_seq,
             queue_depth=self.serve.queue_depth,
-            max_evictions=self.serve.max_evictions)
+            max_evictions=self.serve.max_evictions,
+            prefix_cache=self.prefix_cache)
+        # pool-occupancy high-water marks: raw = every referenced block
+        # (includes trie-retained blocks, which are reclaimable cache);
+        # live = distinct blocks mapped by live sequences — the
+        # occupancy that actually gates admission, and the number
+        # sharing shrinks (two sequences on one physical block count it
+        # once)
+        self.peak_blocks_in_use = 0
+        self.peak_live_blocks = 0
         self._progressed = False        # did the last step() do any work
         self._journal = None            # set by run(); step() journals a
                                         # token BEFORE record_token so the
@@ -224,7 +277,60 @@ class PagedDecodeEngine:
         nxt = jnp.argmax(logits[0, jnp.maximum(n_real - 1, 0)], axis=-1)
         return nxt.astype(jnp.int32), pools
 
+    def _cow_impl(self, pools, src, dst):
+        """Copy one pool block (all layers, K and V): the device half of
+        copy-on-write.  ``src``/``dst`` are traced scalars, so every
+        copy reuses the one compiled program."""
+        return [{"k": p["k"].at[dst].set(p["k"][src]),
+                 "v": p["v"].at[dst].set(p["v"][src])} for p in pools]
+
     # ---------------- host-side step assembly ----------------
+
+    def _ensure_private(self, slot: int, start: int, end: int) -> bool:
+        """Copy-on-write guard for the write_kv path: before a dispatch
+        writes cache positions ``[start, end)`` for ``slot``, any
+        backing block that is SHARED (allocator refcount > 1 — other
+        sequences and/or the prefix trie read it) is replaced by a
+        private copy: allocate a fresh block (evicting under pressure),
+        copy the shared block's contents on device, release the shared
+        reference, and point the block table at the copy.  The one
+        structural trigger is the shared-final-block recompute (a fully
+        cached prompt whose length is an exact block multiple); the
+        decode step runs the same guard as defense in depth — a write
+        may NEVER land in a block another reader maps.
+
+        Returns False when the pool cannot supply a copy target — the
+        caller fails this one request, like any allocation dead end."""
+        if self.prefix_cache is None or start >= end:
+            return True
+        seq = self.sched.slots[slot]
+        bs = self.serve.block_size
+        import jax.numpy as jnp
+
+        for j in range(start // bs, (end - 1) // bs + 1):
+            if j >= len(seq.block_ids):
+                continue            # growth handled by ensure_block
+            src = seq.block_ids[j]
+            if self.allocator.refcount(src) <= 1:
+                continue            # exclusive: in-place write is safe
+            dst = self.sched.alloc_for(slot)
+            if dst is None:
+                return False
+            self.pools = self._cow_fn(self.pools,
+                                      jnp.asarray(src, jnp.int32),
+                                      jnp.asarray(dst, jnp.int32))
+            self.allocator.release([src])
+            seq.block_ids[j] = dst
+            self.sched.counters["prefix_cow_copies"] += 1
+        return True
+
+    def _track_occupancy(self) -> None:
+        """Advance the pool-occupancy high-water marks (see reset)."""
+        self.peak_blocks_in_use = max(self.peak_blocks_in_use,
+                                      self.allocator.num_used)
+        live = {b for s in self.sched.slots if s is not None
+                for b in s.block_ids}
+        self.peak_live_blocks = max(self.peak_live_blocks, len(live))
 
     def _table_row(self, seq, width: int) -> np.ndarray:
         row = np.zeros((width,), np.int32)
@@ -253,6 +359,13 @@ class PagedDecodeEngine:
         prompt = seq.request.prompt
         self._progressed = True          # a chunk enters the pool
         chunk = prompt[seq.prefilled:seq.prefilled + self.serve.prefill_chunk]
+        if not self._ensure_private(slot, seq.prefilled,
+                                    seq.prefilled + len(chunk)):
+            # no pool room for a private copy of a shared block this
+            # chunk writes into: fail this one request, keep serving
+            self._prefill_queue.pop(0)
+            self.sched.fail_live(slot, "rejected")
+            return []
         sb = _bucket(len(chunk), self.serve.prefill_chunk)
         toks = np.zeros((1, sb), np.int32)
         toks[0, :len(chunk)] = chunk
@@ -266,6 +379,12 @@ class PagedDecodeEngine:
         if seq.prefilled < len(prompt):
             return []
         self._prefill_queue.pop(0)
+        if self.prefix_cache is not None:
+            # register the fully prefilled prompt's full blocks BEFORE
+            # record_token can finish the request and release them: the
+            # trie's own reference is what keeps a cached block alive
+            # past its donor sequence
+            self.prefix_cache.insert(prompt, seq.block_ids)
         # the prompt's last position already yields the first output
         # token (exactly generate()'s prefill-argmax), so the slot
         # enters the decode pool one token ahead
@@ -284,6 +403,7 @@ class PagedDecodeEngine:
 
         self._progressed = False
         admitted = self.sched.admit()
+        self._track_occupancy()
         if admitted:
             self._progressed = True
         self._prefill_queue.extend(
@@ -304,9 +424,13 @@ class PagedDecodeEngine:
                 # must never take the engine down.
                 self.sched.fail_live(slot, "rejected")
                 continue
+            if not self._ensure_private(slot, seq.length - 1, seq.length):
+                self.sched.fail_live(slot, "rejected")
+                continue
             live.append(slot)
-        # eviction inside ensure_block may have retired a later slot
+        # eviction inside ensure_block/CoW may have retired a later slot
         live = [s for s in live if self.sched.slots[s] is not None]
+        self._track_occupancy()
         if not live:
             return emitted
         self._progressed = True
@@ -440,6 +564,9 @@ class PagedDecodeEngine:
                 if delay > 0:
                     time.sleep(delay)
         elapsed = time_fn() - t0
+        # pool-leak invariant: every terminal request released its
+        # blocks; only the prefix trie's own references may remain
+        self.sched.check_quiescent()
         outputs = {s.request.id: list(s.generated)
                    for s in self.sched.finished}
         total = sum(len(v) for v in outputs.values())
@@ -461,6 +588,9 @@ class PagedDecodeEngine:
                 "budget_ms": serve.drain_ms,
             },
             "kernel": self.kernel,
+            "prefix": self.prefix_block(),
+            "peak_blocks_in_use": self.peak_blocks_in_use,
+            "peak_live_blocks": self.peak_live_blocks,
             "tokens": total,
             "elapsed_s": elapsed,
             "tokens_per_sec": total / elapsed if elapsed > 0 else 0.0,
@@ -469,6 +599,19 @@ class PagedDecodeEngine:
             "evictions": self.sched.evictions,
             "dispatch_shapes": sorted(self.dispatch_shapes),
         }
+
+    def prefix_block(self) -> dict:
+        """Canonical prefix-cache accounting block for this engine's
+        run (utils/metrics_writer.prefix_block — the ONE constructor
+        engine results, the recovery supervisor, and bench JSON
+        share)."""
+        from mpi_tensorflow_tpu.utils.metrics_writer import prefix_block
+
+        return prefix_block(
+            self.sched.counters,
+            enabled=self.prefix_cache is not None,
+            trie_blocks=(self.prefix_cache.num_blocks
+                         if self.prefix_cache is not None else 0))
 
     def compile_counts(self) -> dict:
         """Live jit-cache entry counts — THE zero-recompile probe: a
@@ -483,4 +626,5 @@ class PagedDecodeEngine:
             except Exception:
                 return None
         return {"decode": size(self._decode_fn),
-                "prefill": size(self._prefill_fn)}
+                "prefill": size(self._prefill_fn),
+                "cow": size(self._cow_fn)}

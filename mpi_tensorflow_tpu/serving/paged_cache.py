@@ -5,8 +5,19 @@ head_dim)`` K and V blocks per transformer layer (ops/paged_attention
 reads/writes it through per-sequence block tables; the head-major
 layout lets the fused Pallas kernel stream whole ``(H, block_size, D)``
 blocks with no transpose).  The host side —
-this module — owns WHICH block belongs to WHOM: a free-list allocator
-whose accounting the scheduler's admit/evict decisions hang off.
+this module — owns WHICH block belongs to WHOM: a refcounted free-list
+allocator whose accounting the scheduler's admit/evict decisions hang
+off.
+
+Refcounts are what make PHYSICAL BLOCK SHARING safe (the PagedAttention
+sharing/CoW design, arXiv:2309.06180): a prompt-prefix block cached by
+the radix trie (serving/prefix_cache) is referenced by every sequence
+whose table maps it PLUS the trie itself, and it returns to the free
+list only when the last reference releases it.  ``alloc`` hands out
+exclusive blocks (refcount 1), ``share`` adds a reference to a live
+block, ``release`` drops one — all frees in the serving stack route
+through ``release`` so releasing a sequence that shares prefix blocks
+with live sequences can never corrupt them.
 
 Block 0 is reserved as the null/scratch block (masked-lane scatter
 target, ops/paged_attention.NULL_BLOCK) and is never handed out.
@@ -14,11 +25,12 @@ target, ops/paged_attention.NULL_BLOCK) and is never handed out.
 
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List
 
 
 class BlockAllocator:
-    """Free-list allocator over pool block ids ``1..num_blocks-1``.
+    """Refcounted free-list allocator over pool block ids
+    ``1..num_blocks-1``.
 
     Pure host Python (no jax import): the scheduler tests exercise
     admit/evict accounting without a device.  LIFO reuse keeps recently
@@ -32,7 +44,7 @@ class BlockAllocator:
                 f"got {num_blocks}")
         self.num_blocks = num_blocks
         self._free: List[int] = list(range(num_blocks - 1, 0, -1))
-        self._used: set = set()
+        self._ref: Dict[int, int] = {}     # block id -> refcount (>= 1)
 
     @property
     def num_free(self) -> int:
@@ -40,34 +52,66 @@ class BlockAllocator:
 
     @property
     def num_used(self) -> int:
-        return len(self._used)
+        return len(self._ref)
+
+    def refcount(self, block: int) -> int:
+        """Live references on ``block`` (0 = free / never allocated).
+        A count > 1 means the block is SHARED — a writer must
+        copy-on-write instead of scattering into it in place."""
+        return self._ref.get(block, 0)
 
     def can_alloc(self, n: int) -> bool:
         return n <= len(self._free)
 
     def alloc(self, n: int) -> List[int]:
-        """Take ``n`` blocks; raises when the pool cannot cover them —
-        callers gate on ``can_alloc`` (admission) or evict first."""
+        """Take ``n`` exclusive blocks (refcount 1); raises when the pool
+        cannot cover them — callers gate on ``can_alloc`` (admission) or
+        evict first."""
         if n > len(self._free):
             raise RuntimeError(
                 f"block pool exhausted: want {n}, have {len(self._free)} "
                 f"free of {self.num_blocks - 1}")
         out = [self._free.pop() for _ in range(n)]
-        self._used.update(out)
+        for b in out:
+            self._ref[b] = 1
         return out
 
-    def free(self, ids: List[int]) -> None:
+    def share(self, ids: List[int]) -> None:
+        """Add one reference to each live block — the prefix cache maps
+        an already-cached block into a new sequence's table instead of
+        recomputing it."""
         for b in ids:
-            if b not in self._used:
+            if b not in self._ref:
+                raise ValueError(f"share of free / foreign block id {b}")
+            self._ref[b] += 1
+
+    def release(self, ids: List[int]) -> None:
+        """Drop one reference per block; a block returns to the free
+        list only at refcount zero.  THE one free path: callers never
+        need to know whether a block is shared."""
+        for b in ids:
+            c = self._ref.get(b, 0)
+            if c < 1:
                 raise ValueError(f"double free / foreign block id {b}")
-            self._used.remove(b)
-            self._free.append(b)
+            if c == 1:
+                del self._ref[b]
+                self._free.append(b)
+            else:
+                self._ref[b] = c - 1
+
+    # legacy name: every free is a refcounted release (a block alloc'd
+    # once and never shared behaves exactly as the pre-refcount free)
+    free = release
 
     def check(self) -> None:
-        """Invariant: every non-null block is free xor used, once."""
-        assert len(self._free) + len(self._used) == self.num_blocks - 1
+        """Invariant: every non-null block is free xor referenced, once;
+        every referenced block carries a positive refcount."""
+        assert len(self._free) + len(self._ref) == self.num_blocks - 1
         assert len(set(self._free)) == len(self._free)
-        assert not (set(self._free) & self._used)
+        assert not (set(self._free) & set(self._ref))
+        assert 0 not in self._ref and 0 not in self._free
+        assert all(c >= 1 for c in self._ref.values()), \
+            f"non-positive refcount in {self._ref}"
 
 
 def blocks_for(tokens: int, block_size: int) -> int:

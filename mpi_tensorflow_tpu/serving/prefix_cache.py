@@ -1,0 +1,197 @@
+"""Radix prefix cache: cross-request reuse of shared-prefix KV blocks.
+
+Production serving traffic is dominated by shared prefixes — system
+prompts, few-shot templates, multi-turn history.  The block table
+already gives every sequence per-block indirection into one physical
+pool (serving/paged_cache), which is exactly the machinery
+PagedAttention (Kwon et al., arXiv:2309.06180) identifies as enabling
+PHYSICAL block sharing; SGLang's RadixAttention (Zheng et al.,
+arXiv:2312.07104) extends it to automatic cross-request prefix reuse
+through a radix tree over token sequences.  This module is that tree at
+BLOCK granularity:
+
+- A trie node represents one FULL block of prompt tokens in context —
+  its key is the block's token tuple, its path from the root is the
+  whole prefix, and it pins one physical pool block holding that
+  prefix's KV.  (Token-exact keys, so a hash collision can never alias
+  two different prefixes to one block.)
+- ``match_and_share`` walks a new prompt's full blocks down the trie
+  and maps every hit to the EXISTING physical block (one ``share`` ref
+  each) instead of recomputing it: prefill is charged only for the
+  unique suffix, and pool occupancy drops by one block per hit.
+- ``insert`` runs when a sequence finishes prefill: the trie adopts the
+  sequence's full-prompt blocks it has not seen before (its own
+  ``share`` ref per node), making them matchable by later requests.
+  Only full PROMPT blocks enter the trie — the partial tail block that
+  also receives generated tokens never does, so a cached block's
+  content is immutable by construction and writes into shared blocks
+  happen only on the engine's explicit copy-on-write path.
+- ``evict`` frees least-recently-used UNREFERENCED leaves (refcount 1:
+  only the trie holds the block) under pool pressure, so sharing never
+  starves admission.  Leaves only: an interior node's children encode
+  prefixes that run THROUGH it, and evicting it would strand their
+  references behind an unmatchable path.
+
+Pure host Python, no jax import — the scheduler consumes it and the
+unit tests exercise it without a device.  Determinism contract: a
+matched block holds KV bit-identical to what re-prefilling those
+positions would write (same tokens, same absolute positions, same
+deterministic forward), so greedy decode with the cache on is
+token-identical to cache-off (pinned by tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from mpi_tensorflow_tpu.serving.paged_cache import BlockAllocator
+
+
+class _Node:
+    """One full token-block of prefix context pinning one pool block."""
+
+    __slots__ = ("key", "block", "children", "parent", "last_used")
+
+    def __init__(self, key: Tuple[int, ...], block: int,
+                 parent: Optional["_Node"], last_used: int):
+        self.key = key
+        self.block = block
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.parent = parent
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Block-granularity radix trie over prompt prefixes.
+
+    Refcount model: the trie holds exactly ONE allocator reference per
+    node (taken at ``insert``, dropped at eviction); every sequence
+    whose block table maps a cached block holds its own.  So
+    ``refcount == 1`` means "trie only" — evictable; ``> 1`` means live
+    sequences read it — protected.
+    """
+
+    def __init__(self, allocator: BlockAllocator, block_size: int):
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        self.allocator = allocator
+        self.block_size = block_size
+        self._root = _Node((), 0, None, 0)
+        self._clock = 0              # monotone LRU stamp source
+        self.num_blocks = 0          # nodes == distinct pool blocks held
+        self.inserted = 0            # nodes ever adopted
+        self.evicted = 0             # nodes LRU-evicted
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    # ---------------- lookup ----------------
+
+    def match_and_share(self, prompt: List[int]) -> Tuple[List[int], int]:
+        """Longest cached block-prefix of ``prompt``: returns the
+        physical block ids (one ``share`` reference taken on each — the
+        caller owns them like freshly allocated blocks and must
+        ``release`` on any failure path) and the number of prompt
+        tokens they serve.
+
+        The served-token count is capped at ``len(prompt) - 1``: the
+        prefill must recompute at least the final prompt position to
+        emit the first output token (its argmax IS the first generated
+        token).  When every full block hits and the prompt length is an
+        exact block multiple, that recompute lands INSIDE the last
+        shared block — the engine's copy-on-write path detects the
+        shared write and gives the sequence a private copy.
+        """
+        node, ids = self._root, []
+        bs = self.block_size
+        for j in range(len(prompt) // bs):
+            child = node.children.get(tuple(prompt[j * bs:(j + 1) * bs]))
+            if child is None:
+                break
+            child.last_used = self._tick()
+            ids.append(child.block)
+            node = child
+        self.allocator.share(ids)
+        cached = len(ids) * bs
+        if cached >= len(prompt):
+            cached = len(prompt) - 1
+        return ids, cached
+
+    # ---------------- registration ----------------
+
+    def insert(self, prompt: List[int], block_ids: List[int]) -> int:
+        """Register a FULLY PREFILLED prompt's full blocks; the trie
+        adopts (one ``share`` ref) each block it has no node for yet.
+        Blocks already cached keep their existing node — a sequence
+        that recomputed a cached block privately (CoW, or an unaligned
+        suffix) simply keeps its private copy.  Returns nodes added."""
+        node, added = self._root, 0
+        bs = self.block_size
+        for j in range(len(prompt) // bs):
+            key = tuple(prompt[j * bs:(j + 1) * bs])
+            child = node.children.get(key)
+            if child is None:
+                self.allocator.share([block_ids[j]])
+                child = _Node(key, block_ids[j], node, 0)
+                node.children[key] = child
+                self.num_blocks += 1
+                self.inserted += 1
+                added += 1
+            child.last_used = self._tick()
+            node = child
+        return added
+
+    # ---------------- eviction ----------------
+
+    def _leaves(self) -> List[_Node]:
+        out, stack = [], list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            if n.children:
+                stack.extend(n.children.values())
+            else:
+                out.append(n)
+        return out
+
+    def evict(self, want_blocks: int) -> int:
+        """Release up to ``want_blocks`` pool blocks by evicting
+        least-recently-used UNREFERENCED leaves (allocator refcount 1).
+        Evicting a leaf can expose its parent as the next candidate.
+        Returns blocks actually freed — the caller falls back to
+        sequence eviction for the remainder.  (Linear leaf scan per
+        freed block: trie size is bounded by the pool, and eviction
+        only runs under pool pressure.)"""
+        freed = 0
+        while freed < want_blocks:
+            victims = [n for n in self._leaves()
+                       if self.allocator.refcount(n.block) == 1]
+            if not victims:
+                break
+            victim = min(victims, key=lambda n: n.last_used)
+            assert not victim.children
+            del victim.parent.children[victim.key]
+            self.allocator.release([victim.block])
+            self.num_blocks -= 1
+            self.evicted += 1
+            freed += 1
+        return freed
+
+    # ---------------- invariants / stats ----------------
+
+    def check(self) -> None:
+        """Every node pins a live, distinct pool block."""
+        seen, stack = set(), list(self._root.children.values())
+        while stack:
+            n = stack.pop()
+            assert self.allocator.refcount(n.block) >= 1, \
+                f"trie node holds freed block {n.block}"
+            assert n.block not in seen, \
+                f"two trie nodes share physical block {n.block}"
+            seen.add(n.block)
+            stack.extend(n.children.values())
+        assert len(seen) == self.num_blocks
+
+    def stats(self) -> dict:
+        return {"blocks": self.num_blocks, "inserted": self.inserted,
+                "evicted": self.evicted}

@@ -31,6 +31,16 @@ Layers:
                       Non-transient errors (shape bugs, OOM) re-raise
                       immediately — a deterministic bug replayed forever
                       is a worse failure mode.
+
+Prefix cache x replay: the radix trie (serving/prefix_cache) indexes
+content that lives in the DEVICE pool, so it dies with the engine and
+is rebuilt from scratch by the replayed prefills themselves — a
+replacement engine's trie starts empty, the re-rooted
+``prompt + prefix`` prompts repopulate it as they prefill, and replayed
+requests that share prefixes re-share blocks in the new pool.  Nothing
+about the trie is journaled (journaling it would pin device state the
+crash just lost); the journal's token streams stay the single durable
+truth, and the replay is token-identical with the cache on or off.
 """
 
 from __future__ import annotations
@@ -256,4 +266,15 @@ def run_with_replay(make_engine: Callable[[], "object"],
 
     res["faults"] = faults_block(totals)
     res["replays"] = attempt
+    if "prefix" in res:
+        # prefix-cache accounting merged across every attempt (each
+        # attempt's counters were folded into ``totals`` above) — a
+        # replayed prefill that re-hits the rebuilt trie counts, same
+        # as the fault counters do.  Same constructor as the engine's
+        # own prefix block, so the two shapes cannot drift
+        from mpi_tensorflow_tpu.utils.metrics_writer import prefix_block
+
+        res["prefix"] = prefix_block(
+            totals, enabled=res["prefix"]["enabled"],
+            trie_blocks=res["prefix"]["trie_blocks"])
     return res

@@ -39,6 +39,7 @@ from typing import Callable, Dict, List, Optional
 
 from mpi_tensorflow_tpu.serving.paged_cache import (BlockAllocator,
                                                     blocks_for)
+from mpi_tensorflow_tpu.serving.prefix_cache import PrefixCache
 
 #: every terminal status a request can leave the scheduler with
 TERMINAL_STATUSES = ("ok", "rejected", "shed", "deadline_exceeded",
@@ -81,12 +82,18 @@ class RejectedRequest:
 
 @dataclasses.dataclass
 class Sequence:
-    """A live (admitted) sequence: its pool blocks + progress."""
+    """A live (admitted) sequence: its pool blocks + progress.
+
+    ``prefix_cached`` prompt tokens were served by the radix prefix
+    cache at admission: their blocks are SHARED physical blocks mapped
+    straight into ``block_ids`` and ``prefilled`` starts there, so the
+    prefill dispatches only ever compute the unique suffix."""
     request: Request
     block_ids: List[int]
     prefilled: int = 0            # prompt tokens already through prefill
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
+    prefix_cached: int = 0        # prompt tokens served by cache hits
 
     @property
     def length(self) -> int:
@@ -130,6 +137,14 @@ class Scheduler:
                           cannot park old work forever.
     - ``on_terminal(request, status)``  fired exactly once per request
                           as it leaves the system (journal hook).
+    - ``prefix_cache``    radix prefix cache (serving/prefix_cache);
+                          admission maps cached full prompt blocks into
+                          the new sequence's table (shared, refcounted)
+                          and charges it only for the unique suffix.
+                          Under pool pressure, unreferenced cached
+                          blocks are LRU-evicted BEFORE any live
+                          sequence is preempted.  None = sharing off —
+                          byte-for-byte today's behavior.
     """
 
     def __init__(self, allocator: BlockAllocator, max_slots: int,
@@ -138,7 +153,8 @@ class Scheduler:
                  max_evictions: Optional[int] = None,
                  starvation_steps: Optional[int] = 64,
                  on_terminal: Optional[Callable[[Request, str],
-                                                None]] = None):
+                                                None]] = None,
+                 prefix_cache: Optional[PrefixCache] = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.allocator = allocator
@@ -149,6 +165,7 @@ class Scheduler:
         self.max_evictions = max_evictions
         self.starvation_steps = starvation_steps
         self.on_terminal = on_terminal
+        self.prefix_cache = prefix_cache
         self.waiting: deque = deque()
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.finished: List[Sequence] = []
@@ -223,15 +240,33 @@ class Scheduler:
         Aging guard: a head blocked on blocks for ``starvation_steps``
         consecutive admit calls preempts sequences YOUNGER than itself
         to free the blocks it needs — requeued (evicted) old work makes
-        progress even under a hot stream of later arrivals."""
+        progress even under a hot stream of later arrivals.
+
+        Prefix sharing: the head's prompt is first walked through the
+        radix cache — every cached full block is mapped (shared) into
+        the new table and the admission is charged only for the unique
+        suffix, so a hot system prompt costs its blocks ONCE across the
+        whole pool.  The matched blocks are pinned (one reference) for
+        the duration of the attempt, so the trie eviction that reclaim
+        may trigger can never free them out from under the admit."""
         admitted = []
         while self.waiting:
             slot = self.free_slot()
             if slot is None:
                 break
             req = self.waiting[0]
-            need = blocks_for(len(req.prompt) + 1, self.block_size)
-            if not self.allocator.can_alloc(need):
+            cached_ids: List[int] = []
+            cached_tokens = 0
+            if self.prefix_cache is not None:
+                cached_ids, cached_tokens = \
+                    self.prefix_cache.match_and_share(req.prompt)
+            need = blocks_for(len(req.prompt) + 1, self.block_size) \
+                - len(cached_ids)
+            if not self._reclaim(need):
+                if cached_ids:
+                    # un-pin this attempt's matched blocks; the trie
+                    # keeps them and the next attempt re-matches
+                    self.allocator.release(cached_ids)
                 if self._head_blocked_id != req.id:
                     # a different head (the old one admitted/expired):
                     # starvation credit starts over
@@ -250,7 +285,13 @@ class Scheduler:
                 break
             self._head_blocked = 0
             self.waiting.popleft()
-            self.slots[slot] = Sequence(req, self.allocator.alloc(need))
+            if self.prefix_cache is not None:
+                self.counters["prefix_prompt_tokens"] += len(req.prompt)
+                self.counters["prefix_hit_tokens"] += cached_tokens
+                self.counters["prefix_shared_blocks"] += len(cached_ids)
+            self.slots[slot] = Sequence(
+                req, cached_ids + self.allocator.alloc(need),
+                prefilled=cached_tokens, prefix_cached=cached_tokens)
             admitted.append(slot)
         return admitted
 
@@ -260,6 +301,31 @@ class Scheduler:
         return [i for i, s in enumerate(self.slots)
                 if s is not None and s.prefilled > 0]
 
+    def _reclaim(self, n: int) -> bool:
+        """``can_alloc`` with prefix-cache backpressure: under pool
+        pressure, LRU-evict unreferenced cached blocks from the trie
+        before reporting failure — sharing must never starve admission
+        or decode growth.  Sequence eviction stays the CALLER'S
+        fallback (and is re-followed by a reclaim: a preempted victim's
+        release can leave blocks pinned only by the trie)."""
+        if self.allocator.can_alloc(n):
+            return True
+        if self.prefix_cache is not None:
+            freed = self.prefix_cache.evict(n - self.allocator.num_free)
+            if freed:
+                self.counters["prefix_trie_evictions"] += freed
+        return self.allocator.can_alloc(n)
+
+    def alloc_for(self, slot: int) -> Optional[int]:
+        """One fresh exclusive block for ``slot`` (table growth or a
+        copy-on-write target), evicting trie entries then younger
+        sequences under pressure.  None = pool exhausted with nothing
+        left to evict — the caller fails this one request."""
+        while not self._reclaim(1):
+            if not self._evict_youngest(protect=slot):
+                return None
+        return self.allocator.alloc(1)[0]
+
     def ensure_block(self, slot: int) -> bool:
         """Make sure the slot's table covers cache position ``length-1``
         (where this step writes the pending token, growing the cache to
@@ -268,11 +334,10 @@ class Scheduler:
         seq = self.slots[slot]
         need = blocks_for(seq.length, self.block_size)
         while len(seq.block_ids) < need:
-            if not self.allocator.can_alloc(1):
-                if not self._evict_youngest(protect=slot):
-                    return False
-                continue
-            seq.block_ids.extend(self.allocator.alloc(1))
+            b = self.alloc_for(slot)
+            if b is None:
+                return False
+            seq.block_ids.append(b)
         return True
 
     def _evict_youngest(self, protect: Optional[int],
@@ -286,7 +351,12 @@ class Scheduler:
         preempt work older than the request it serves).  A victim past
         its ``max_evictions`` budget is failed with ``evicted_too_often``
         instead of requeued — its blocks still free, so the caller's
-        allocation can proceed either way."""
+        allocation can proceed either way.
+
+        Frees route through the refcounted ``release``: evicting a
+        victim that SHARES prefix blocks with live sequences (or the
+        trie) only drops its references — the survivors' tables stay
+        intact (regression-pinned by tests/test_serving.py)."""
         candidates = [(self.slots[i].request.arrival, i)
                       for i in range(self.max_slots)
                       if self.slots[i] is not None and i != protect
@@ -296,7 +366,7 @@ class Scheduler:
             return False
         _, victim = max(candidates)
         seq = self.slots[victim]
-        self.allocator.free(seq.block_ids)
+        self.allocator.release(seq.block_ids)
         self.slots[victim] = None
         self.evictions += 1
         self.counters["evictions"] += 1
@@ -323,7 +393,7 @@ class Scheduler:
         if (len(seq.generated) >= seq.request.max_new_tokens
                 or (eos_id is not None and token == eos_id)):
             seq.done = True
-            self.allocator.free(seq.block_ids)
+            self.allocator.release(seq.block_ids)
             seq.block_ids = []
             self.finished.append(seq)
             self.slots[slot] = None
@@ -340,7 +410,7 @@ class Scheduler:
         """Terminate ONE live sequence with ``status``: free its blocks,
         recycle the slot — the other in-flight streams keep serving."""
         seq = self.slots[slot]
-        self.allocator.free(seq.block_ids)
+        self.allocator.release(seq.block_ids)
         seq.block_ids = []
         self.slots[slot] = None
         self._terminal(seq.request, status)
@@ -387,3 +457,17 @@ class Scheduler:
 
     def all_done(self) -> bool:
         return not self.waiting and all(s is None for s in self.slots)
+
+    def check_quiescent(self) -> None:
+        """Pool-leak invariant at the end of a run: every terminal
+        request released its blocks, the free list + refcount map
+        partition the pool, and the only references left standing are
+        the prefix trie's own (one per cached node)."""
+        self.allocator.check()
+        held = self.prefix_cache.num_blocks \
+            if self.prefix_cache is not None else 0
+        assert self.allocator.num_used == held, (
+            f"pool leak: {self.allocator.num_used} blocks referenced at "
+            f"quiescence, prefix trie accounts for {held}")
+        if self.prefix_cache is not None:
+            self.prefix_cache.check()

@@ -100,6 +100,26 @@ def faults_block(counters) -> dict:
     return {k: int(counters.get(k, 0)) for k in SERVING_FAULT_KEYS}
 
 
+def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0) -> dict:
+    """Normalize scheduler/supervisor counters into the canonical
+    serving ``prefix`` (radix prefix cache) accounting block — one
+    constructor shared by engine results, the recovery supervisor's
+    cross-attempt merge, and bench JSON, so the key set and the
+    hit-rate rounding can never drift between them."""
+    hit = int(counters.get("prefix_hit_tokens", 0))
+    total = int(counters.get("prefix_prompt_tokens", 0))
+    return {
+        "enabled": bool(enabled),
+        "hit_tokens": hit,
+        "prompt_tokens": total,
+        "hit_rate": round(hit / total, 4) if total else 0.0,
+        "shared_blocks": int(counters.get("prefix_shared_blocks", 0)),
+        "cow_copies": int(counters.get("prefix_cow_copies", 0)),
+        "trie_evictions": int(counters.get("prefix_trie_evictions", 0)),
+        "trie_blocks": int(trie_blocks),
+    }
+
+
 def write_faults(writer: MetricsWriter, counters, step: int = 0,
                  prefix: str = "serving/faults/") -> dict:
     """Stream the normalized faults block through a MetricsWriter (one

@@ -372,6 +372,68 @@ class TestMeasureServing:
                                   journal=str(tmp_path / "j.jsonl"),
                                   kernel_ab=True)
 
+    def test_serving_shared_prefix_workload(self, monkeypatch):
+        """THE prefix-cache acceptance numbers: a shared-prefix trace
+        with the cache on shows hit_rate > 0, live pool occupancy
+        strictly below the cache-off control arm, token identity
+        between the arms, and zero steady-state recompiles preserved."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_serving(num_requests=6, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=8,
+                                  precision="fp32", prefix_cache="on",
+                                  prefix_tokens=16)
+        p = r["prefix"]
+        assert p["enabled"] and r["serve_prefix_cache"] == "on"
+        assert r["serve_prefix_tokens"] == 16
+        assert p["hit_rate"] > 0 and p["hit_tokens"] > 0
+        assert p["peak_live_blocks"] < p["peak_live_blocks_off"], \
+            "sharing must shrink live pool occupancy on this trace"
+        assert p["blocks_saved_peak"] > 0
+        assert p["token_identical_vs_off"], \
+            "prefix cache perturbed greedy outputs"
+        assert r["zero_recompile_steady_state"], r
+        assert r["serving_tokens_per_sec"] > 0
+
+    def test_serving_prefix_off_detail_shape(self, monkeypatch):
+        """Cache off (the default): the prefix block reports disabled
+        and carries no comparison arm."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_serving(num_requests=2, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=4,
+                                  precision="fp32", prefix_tokens=8)
+        assert r["serve_prefix_cache"] == "off"
+        assert not r["prefix"]["enabled"]
+        assert "peak_live_blocks_off" not in r["prefix"]
+
+    def test_serving_prefix_rejects_kernel_ab_combo(self):
+        """One comparison, one variable: the prefix-cache control arm
+        and the kernel A/B arm cannot share a run."""
+        with pytest.raises(ValueError, match="prefix-cache"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  prefix_cache="on", kernel_ab=True)
+
+    def test_serving_negative_prefix_tokens_rejected(self):
+        with pytest.raises(ValueError, match="prefix-tokens"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  prefix_tokens=-1)
+
+    def test_serving_prefix_flags_guarded_outside_serving_mode(self):
+        """--serve-prefix-* shape the serving trace; any other mode
+        would silently ignore them — reject the combo up front."""
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "train", "--serve-prefix-tokens", "64"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "decode", "--serve-prefix-cache", "on"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-prefix-cache", "on",
+                        "--serve-kernel-ab"])
+
 
 class TestHostIo:
     def test_hostio_smoke_reports_all_paths(self):

@@ -17,7 +17,8 @@ import pytest
 
 from mpi_tensorflow_tpu.models import bert, gpt
 from mpi_tensorflow_tpu.serving import (BlockAllocator, PagedDecodeEngine,
-                                        Request, Scheduler, ServeConfig)
+                                        PrefixCache, Request, Scheduler,
+                                        ServeConfig)
 from mpi_tensorflow_tpu.serving.paged_cache import blocks_for, init_pools
 
 TINY = dataclasses.replace(bert.BERT_TINY, ce_positions="all")
@@ -87,6 +88,170 @@ class TestBlockAllocator:
             a.check()
         flat = [b for grp in held for b in grp]
         assert len(flat) == len(set(flat)) == a.num_used
+
+    def test_share_release_refcount_semantics(self):
+        """A shared block survives every release but the last; freeing
+        happens exactly at refcount zero."""
+        a = BlockAllocator(8)
+        (b,) = a.alloc(1)
+        a.share([b])
+        a.share([b])
+        assert a.refcount(b) == 3
+        a.release([b])
+        a.release([b])
+        assert a.refcount(b) == 1 and a.num_used == 1
+        a.check()
+        a.release([b])
+        assert a.refcount(b) == 0 and a.num_free == 7
+        a.check()
+
+    def test_share_of_free_block_raises(self):
+        a = BlockAllocator(8)
+        with pytest.raises(ValueError, match="share of free"):
+            a.share([3])
+        (b,) = a.alloc(1)
+        a.release([b])
+        with pytest.raises(ValueError, match="share of free"):
+            a.share([b])
+
+    def test_release_below_zero_raises(self):
+        a = BlockAllocator(8)
+        (b,) = a.alloc(1)
+        a.share([b])
+        a.release([b])
+        a.release([b])
+        with pytest.raises(ValueError, match="double free"):
+            a.release([b])
+
+    def test_randomized_share_release_property(self):
+        """THE pool-leak property pin: a random interleaving of
+        alloc/share/release against a model refcount map keeps the
+        allocator's refcount/free-list accounting exact at every step
+        and drains to empty."""
+        rng = np.random.default_rng(7)
+        a = BlockAllocator(24)
+        refs = {}                       # model: block -> refcount
+        for _ in range(600):
+            r = rng.random()
+            if r < 0.35 and a.can_alloc(1):
+                (b,) = a.alloc(1)
+                assert b not in refs
+                refs[b] = 1
+            elif r < 0.6 and refs:
+                b = list(refs)[rng.integers(len(refs))]
+                a.share([b])
+                refs[b] += 1
+            elif refs:
+                b = list(refs)[rng.integers(len(refs))]
+                a.release([b])
+                refs[b] -= 1
+                if refs[b] == 0:
+                    del refs[b]
+            a.check()
+            assert a.num_used == len(refs)
+            for b, c in refs.items():
+                assert a.refcount(b) == c
+        for b in sorted(refs):
+            a.release([b] * refs[b])
+        a.check()
+        assert a.num_used == 0 and a.num_free == 23
+
+
+# ---------------------------------------------------------- prefix trie
+
+@pytest.mark.quick
+class TestPrefixCache:
+    def test_empty_trie_misses(self):
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        ids, cached = pc.match_and_share(list(range(12)))
+        assert ids == [] and cached == 0 and a.num_used == 0
+
+    def test_insert_then_match_shares_full_blocks(self):
+        """A cached prompt's full blocks map into a later request; the
+        trie and the matcher each hold their own reference."""
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        prompt = list(range(10))             # 2 full blocks + 2 tail
+        blocks = a.alloc(3)
+        pc.insert(prompt, blocks)
+        assert pc.num_blocks == 2            # tail block never cached
+        assert a.refcount(blocks[0]) == a.refcount(blocks[1]) == 2
+        assert a.refcount(blocks[2]) == 1
+        ids, cached = pc.match_and_share(prompt + [99])
+        assert ids == blocks[:2] and cached == 8
+        assert a.refcount(blocks[0]) == 3
+        pc.check()
+
+    def test_full_prompt_match_caps_at_len_minus_one(self):
+        """An exact-block-multiple prompt fully in cache still leaves
+        ONE token to prefill (its argmax is the first output token);
+        all matched blocks stay shared — the recompute write is the
+        engine's CoW trigger."""
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        prompt = list(range(8))
+        blocks = a.alloc(2)
+        pc.insert(prompt, blocks)
+        ids, cached = pc.match_and_share(list(prompt))
+        assert ids == blocks and cached == 7
+        a.release(ids)
+
+    def test_match_stops_at_divergent_block(self):
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        pc.insert(list(range(8)), a.alloc(2))
+        ids, cached = pc.match_and_share([0, 1, 2, 3, 9, 9, 9, 9, 7])
+        assert len(ids) == 1 and cached == 4
+        a.release(ids)
+
+    def test_lru_eviction_frees_only_unreferenced_leaves(self):
+        """Eviction order is LRU over leaves whose block only the trie
+        holds; blocks live sequences still map are untouchable."""
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        p1, p2 = [1] * 4, [2] * 4
+        (b1,) = a.alloc(1)
+        pc.insert(p1, [b1])
+        (b2,) = a.alloc(1)
+        pc.insert(p2, [b2])
+        a.release([b1, b2])                  # donors finished: trie-only
+        ids, _ = pc.match_and_share(p2 + [5])   # p2 recently used + pinned
+        assert pc.evict(10) == 1             # only p1's block was free
+        assert a.refcount(b1) == 0 and pc.num_blocks == 1
+        a.release(ids)
+        assert pc.evict(10) == 1             # now p2's is reclaimable
+        assert pc.num_blocks == 0 and a.num_used == 0
+        a.check()
+
+    def test_lru_order_evicts_least_recent_first(self):
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        (b1,) = a.alloc(1)
+        pc.insert([1] * 4, [b1])
+        (b2,) = a.alloc(1)
+        pc.insert([2] * 4, [b2])
+        a.release([b1, b2])
+        ids, _ = pc.match_and_share([1] * 4 + [0])    # touch prefix 1
+        a.release(ids)
+        assert pc.evict(1) == 1
+        assert a.refcount(b2) == 0, "LRU entry must go first"
+        assert a.refcount(b1) == 1
+
+    def test_eviction_is_leaf_first(self):
+        """An interior node cannot be evicted while a child pins the
+        path; evicting the leaf exposes it."""
+        a = BlockAllocator(16)
+        pc = PrefixCache(a, 4)
+        prompt = list(range(8))              # parent + child chain
+        blocks = a.alloc(2)
+        pc.insert(prompt, blocks)
+        a.release(blocks)                    # donor gone: both trie-only
+        assert pc.evict(1) == 1
+        # the LEAF (deeper block) went first; the parent remains
+        assert a.refcount(blocks[1]) == 0 and a.refcount(blocks[0]) == 1
+        assert pc.evict(1) == 1 and pc.num_blocks == 0
+        a.check()
 
 
 # ------------------------------------------------------------- scheduler
@@ -210,8 +375,9 @@ class TestScheduler:
         assert s.ensure_block(0)             # eviction 1: requeued
         assert s.waiting[0].id == 1 and 1 not in s.statuses
         # re-admit the victim, then force a second eviction
-        s.allocator.free([b for b in list(s.allocator._used)
-                          if b not in s.slots[0].block_ids])
+        s.allocator.free([b for b in range(1, s.allocator.num_blocks)
+                          if s.allocator.refcount(b)
+                          and b not in s.slots[0].block_ids])
         for slot in s.admit():
             s.slots[slot].prefilled = 7
         s.record_token(0, 5)                 # length 10: 3 blocks cover
@@ -254,6 +420,79 @@ class TestScheduler:
         for _ in range(10):
             assert s.admit() == []
         assert s.slots[0] is not None and s.evictions == 0
+
+    def test_prefix_admission_charges_only_the_unique_suffix(self):
+        """With a cached prefix, admission maps the shared blocks and
+        allocates fresh ones for the suffix alone; prefill starts past
+        the cached tokens."""
+        a = BlockAllocator(32)
+        pc = PrefixCache(a, 4)
+        s = Scheduler(a, 2, 4, 8, prefix_cache=pc)
+        p0 = list(range(8))
+        s.submit(Request(0, p0, 4, arrival=0.0))
+        (slot0,) = s.admit()
+        seq0 = s.slots[slot0]
+        assert seq0.prefix_cached == 0          # cold trie: full prefill
+        seq0.prefilled = 8
+        pc.insert(p0, seq0.block_ids)
+        used_before = a.num_used
+        s.submit(Request(1, p0 + [9, 9], 4, arrival=1.0))
+        (slot1,) = s.admit()
+        seq1 = s.slots[slot1]
+        assert seq1.block_ids[:2] == seq0.block_ids[:2], \
+            "cached prefix must map the SAME physical blocks"
+        assert seq1.prefix_cached == 8 and seq1.prefilled == 8
+        # 10+1 tokens need 3 blocks; 2 came from the cache -> 1 fresh
+        assert a.num_used == used_before + 1
+        assert a.refcount(seq0.block_ids[0]) == 3   # seq0 + trie + seq1
+        assert s.counters["prefix_hit_tokens"] == 8
+        a.check()
+        pc.check()
+
+    def test_evicting_sharing_sequence_cannot_corrupt_survivors(self):
+        """THE refcount-release regression pin: evicting a sequence that
+        shares prefix blocks with a live sequence (and the trie) only
+        drops its references — the survivor's table and the cached
+        content stay intact."""
+        a = BlockAllocator(32)
+        pc = PrefixCache(a, 4)
+        s = Scheduler(a, 2, 4, 8, prefix_cache=pc)
+        p0 = list(range(8))
+        s.submit(Request(0, p0, 4, arrival=0.0))
+        (slot0,) = s.admit()
+        seq0 = s.slots[slot0]
+        seq0.prefilled = 8
+        pc.insert(p0, seq0.block_ids)
+        s.submit(Request(1, p0 + [9], 6, arrival=1.0))
+        (slot1,) = s.admit()
+        shared = list(s.slots[slot1].block_ids[:2])
+        s.slots[slot1].prefilled = 9            # mid-decode
+        assert s._evict_youngest(protect=slot0)
+        assert s.slots[slot1] is None
+        for b in shared:
+            assert a.refcount(b) == 2, \
+                "survivor + trie references must survive the eviction"
+        assert s.slots[slot0].block_ids[:2] == shared
+        a.check()
+        pc.check()
+
+    def test_trie_eviction_unblocks_admission_before_preemption(self):
+        """Pool full of trie-retained (reclaimable) blocks: admission
+        reclaims them instead of reporting starvation — sharing never
+        starves admission."""
+        a = BlockAllocator(5)                   # 4 usable
+        pc = PrefixCache(a, 4)
+        s = Scheduler(a, 2, 4, 4, prefix_cache=pc)
+        for i in range(3):                      # fill the pool with
+            blocks = a.alloc(1)                 # finished prompts' cache
+            pc.insert([10 + i] * 4, blocks)
+            a.release(blocks)
+        assert a.num_free == 1 and pc.num_blocks == 3
+        s.submit(Request(0, [1] * 7, 4))        # needs 2 blocks
+        assert s.admit(), "reclaimable cache blocked admission"
+        assert s.counters["prefix_trie_evictions"] >= 1
+        a.check()
+        pc.check()
 
     def test_scripted_trace_invariants(self):
         """Admit/decode/finish churn: at every step the pool partitions
@@ -578,6 +817,134 @@ class TestEngine:
         assert clock["t"] > 0.5
 
 
+# ----------------------------------------------------- prefix cache e2e
+
+class TestPrefixCacheEngine:
+    """The tentpole's determinism contract: under greedy decode,
+    prefix-cache-on outputs are token-identical to cache-off (and to
+    generate()) for every request — across shared-prefix batches, CoW
+    divergence mid-block, and eviction under pressure."""
+
+    def _engine(self, **kw):
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = ServeConfig(**{**dict(num_blocks=48, block_size=4,
+                                      max_slots=3, max_seq_len=32,
+                                      prefill_chunk=8,
+                                      prefix_cache="on"), **kw})
+        return model, params, PagedDecodeEngine(model, params, serve)
+
+    def test_shared_prefix_batch_token_identical_with_hits(self):
+        """Requests sharing a system prompt: later admissions map the
+        cached blocks (hit_rate > 0) and every output still equals
+        generate()'s."""
+        model, params, engine = self._engine()
+        rng = np.random.default_rng(20)
+        shared = list(map(int, rng.integers(0, TINY.vocab_size, 12)))
+        prompts = [shared + list(map(int, rng.integers(
+            0, TINY.vocab_size, int(n)))) for n in rng.integers(1, 8, 7)]
+        budgets = [int(n) for n in rng.integers(1, 7, len(prompts))]
+        res = engine.run([Request(i, p, n, arrival=0.0) for i, (p, n)
+                          in enumerate(zip(prompts, budgets))])
+        for i, (p, n) in enumerate(zip(prompts, budgets)):
+            assert res["outputs"][i] == _generate_ref(model, params, p, n), \
+                f"request {i} diverged with the prefix cache on"
+        assert res["prefix"]["enabled"]
+        assert res["prefix"]["hit_tokens"] > 0
+        assert res["prefix"]["shared_blocks"] > 0
+        # pool-leak invariant at quiescence: only the trie's own refs
+        engine.allocator.check()
+        assert engine.allocator.num_used == engine.prefix_cache.num_blocks
+
+    def test_cow_on_fully_cached_block_multiple_prompt(self):
+        """Identical prompts whose length is an exact block multiple:
+        the follow-ups match EVERY block, recompute only the final
+        position, and that write lands mid-block in a shared block —
+        the copy-on-write trigger.  Outputs must stay exact and the
+        donor's cached content uncorrupted."""
+        # one slot: each request admits only after its predecessor (the
+        # trie donor) finished prefill, so the follow-ups actually hit
+        model, params, engine = self._engine(max_slots=1)
+        rng = np.random.default_rng(21)
+        prompt = list(map(int, rng.integers(0, TINY.vocab_size, 8)))
+        assert len(prompt) % 4 == 0              # exact block multiple
+        budgets = [6, 4, 2]                      # divergent stream lengths
+        res = engine.run([Request(i, list(prompt), n, arrival=0.0)
+                          for i, n in enumerate(budgets)])
+        assert res["prefix"]["cow_copies"] >= 1, \
+            "the shared-final-block recompute must trigger CoW"
+        want = _generate_ref(model, params, prompt, max(budgets))
+        for i, n in enumerate(budgets):
+            assert res["outputs"][i] == want[:n], \
+                f"request {i} diverged after CoW"
+
+    def test_eviction_under_pressure_with_sharing_stays_exact(self):
+        """A tight pool forces preemption while sequences share prefix
+        blocks: evicting a sharer must not corrupt survivors, and every
+        request still completes generate()-identically."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = ServeConfig(num_blocks=10, block_size=2, max_slots=2,
+                            max_seq_len=12, prefill_chunk=2,
+                            prefix_cache="on")
+        engine = PagedDecodeEngine(model, params, serve)
+        rng = np.random.default_rng(22)
+        shared = list(map(int, rng.integers(0, TINY.vocab_size, 4)))
+        pa = shared + list(map(int, rng.integers(0, TINY.vocab_size, 1)))
+        pb = shared + list(map(int, rng.integers(0, TINY.vocab_size, 6)))
+        res = engine.run([Request(0, pa, 7, arrival=0.0),
+                          Request(1, pb, 1, arrival=0.0)])
+        assert engine.sched.evictions + engine.prefix_cache.evicted >= 1, \
+            "trace was meant to exercise eviction under pressure"
+        assert res["outputs"][0] == _generate_ref(model, params, pa, 7)
+        assert res["outputs"][1] == _generate_ref(model, params, pb, 1)
+        engine.allocator.check()
+
+    def test_zero_recompiles_with_prefix_cache_on(self):
+        """The prefix cache (including its CoW copy dispatch) must not
+        break the steady-state zero-recompile contract."""
+        _, _, engine = self._engine()
+        rng = np.random.default_rng(23)
+        shared = list(map(int, rng.integers(0, TINY.vocab_size, 8)))
+        lens = rng.integers(1, 8, 6)
+        budgets = [int(n) for n in rng.integers(1, 8, 6)]
+
+        def trace(seed):
+            r = np.random.default_rng(seed)
+            return [Request(i, shared + list(map(int, r.integers(
+                        0, TINY.vocab_size, int(s)))), budgets[i])
+                    for i, s in enumerate(lens)]
+
+        engine.run(trace(0))
+        warm = engine.compile_counts()
+        assert warm["decode"] > 0 and warm["prefill"] > 0
+        engine.reset()
+        engine.run(trace(9))
+        assert engine.compile_counts() == warm, \
+            "prefix cache added steady-state recompiles"
+
+    def test_off_mode_reports_disabled_and_shares_nothing(self):
+        """--serve-prefix-cache off (the default) must be byte-for-byte
+        today's behavior: no trie, no sharing, no CoW dispatch use."""
+        model, params, engine = self._engine(prefix_cache="off")
+        rng = np.random.default_rng(24)
+        p = list(map(int, rng.integers(0, TINY.vocab_size, 8)))
+        res = engine.run([Request(0, list(p), 3, arrival=0.0),
+                          Request(1, list(p), 3, arrival=0.0)])
+        assert engine.prefix_cache is None
+        assert res["prefix"] == {
+            "enabled": False, "hit_tokens": 0, "prompt_tokens": 0,
+            "hit_rate": 0.0, "shared_blocks": 0, "cow_copies": 0,
+            "trie_evictions": 0, "trie_blocks": 0}
+        assert res["outputs"][0] == res["outputs"][1] \
+            == _generate_ref(model, params, p, 3)
+        assert engine.allocator.num_used == 0
+
+
 # ------------------------------------------------------------ cli guards
 
 @pytest.mark.quick
@@ -634,6 +1001,31 @@ class TestServeCliGuards:
                       ["--serve-drain-ms", "-1"]):
             with pytest.raises(SystemExit, match="fault policy"):
                 cli.main(flags)
+
+    def test_serve_prefix_cache_knob_bridges(self):
+        """--serve-prefix-cache flows CLI -> Config -> ServeConfig,
+        defaulting to off (today's behavior byte-for-byte)."""
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(["--serve-prefix-cache", "on"])
+        c = cli.config_from_args(args)
+        assert c.serve_prefix_cache == "on"
+        assert ServeConfig.from_config(c).prefix_cache == "on"
+        c0 = cli.config_from_args(cli.build_parser().parse_args([]))
+        assert ServeConfig.from_config(c0).prefix_cache == "off"
+
+    def test_bad_serve_prefix_cache_rejected(self):
+        """Invalid values die at both layers: argparse choices on the
+        CLI path, ServeConfig validation on the programmatic path."""
+        from mpi_tensorflow_tpu import cli
+        from mpi_tensorflow_tpu.config import Config
+
+        with pytest.raises(SystemExit):
+            cli.main(["--serve-prefix-cache", "maybe"])
+        with pytest.raises(ValueError, match="prefix cache"):
+            ServeConfig.from_config(Config(serve_prefix_cache="maybe"))
+        with pytest.raises(ValueError, match="prefix cache"):
+            ServeConfig(prefix_cache="auto")
 
     def test_serve_fault_knobs_bridge_to_serve_config(self):
         """The four fault-tolerance knobs flow CLI -> Config ->

@@ -22,6 +22,7 @@ from mpi_tensorflow_tpu.serving import (PagedDecodeEngine, ReplayJournal,
 TINY = dataclasses.replace(bert.BERT_TINY, ce_positions="all")
 SERVE = ServeConfig(num_blocks=40, block_size=4, max_slots=3,
                     max_seq_len=24, prefill_chunk=8)
+PSERVE = dataclasses.replace(SERVE, prefix_cache="on")
 
 
 @pytest.fixture(scope="module")
@@ -36,6 +37,19 @@ def _trace(n=5, seed=2, lo=3, hi=13, budget_hi=9):
     rng = np.random.default_rng(seed)
     prompts = [list(map(int, rng.integers(0, TINY.vocab_size, int(s))))
                for s in rng.integers(lo, hi + 1, n)]
+    budgets = [int(b) for b in rng.integers(2, budget_hi, n)]
+    return [Request(i, p, b)
+            for i, (p, b) in enumerate(zip(prompts, budgets))]
+
+
+def _shared_trace(n=6, seed=3, prefix=8, hi=6, budget_hi=7):
+    """Shared-prefix variant: one common system prompt (an exact block
+    multiple of PSERVE's block_size, so the fully-cached CoW path is in
+    play) ahead of each unique tail."""
+    rng = np.random.default_rng(seed)
+    shared = list(map(int, rng.integers(0, TINY.vocab_size, prefix)))
+    prompts = [shared + list(map(int, rng.integers(
+        0, TINY.vocab_size, int(s)))) for s in rng.integers(1, hi + 1, n)]
     budgets = [int(b) for b in rng.integers(2, budget_hi, n)]
     return [Request(i, p, b)
             for i, (p, b) in enumerate(zip(prompts, budgets))]
@@ -166,14 +180,15 @@ class TestReplayJournal:
 # ------------------------------------------------- replay determinism
 
 class TestTransientReplay:
-    def _flaky_factory(self, model, params, fail_on_call=4, times=1):
+    def _flaky_factory(self, model, params, fail_on_call=4, times=1,
+                       serve=SERVE):
         """Engine factory whose first ``times`` engines raise a
         transient device-loss error on their ``fail_on_call``-th decode
         dispatch — rebuilt engines run clean."""
         state = {"faults_left": times}
 
         def make_engine():
-            engine = PagedDecodeEngine(model, params, SERVE)
+            engine = PagedDecodeEngine(model, params, serve)
             if state["faults_left"] > 0:
                 state["faults_left"] -= 1
                 orig, calls = engine._decode_fn, {"n": 0}
@@ -259,3 +274,65 @@ class TestTransientReplay:
             journal_path=path)
         assert res["outputs"] == want["outputs"]
         assert all(s == "ok" for s in res["statuses"].values())
+
+
+# -------------------------------------------- prefix cache x replay
+
+class TestPrefixCacheReplay:
+    """Journal compatibility for the radix prefix cache: the trie
+    indexes device-pool content, so it dies with the engine and is
+    rebuilt by the replayed prefills — delivered streams must stay
+    token-identical to an unfaulted CACHE-OFF run (the strongest form
+    of the determinism contract)."""
+
+    _flaky_factory = TestTransientReplay._flaky_factory
+
+    def test_replay_after_mid_decode_fault_token_identical(
+            self, model_params):
+        model, params = model_params
+        want = PagedDecodeEngine(model, params, SERVE).run(_shared_trace())
+        res = run_with_replay(
+            self._flaky_factory(model, params, serve=PSERVE),
+            _shared_trace())
+        assert res["replays"] == 1
+        assert res["outputs"] == want["outputs"]
+        assert all(s == "ok" for s in res["statuses"].values())
+        # the rebuilt trie re-served shared prefixes during the replay
+        assert res["prefix"]["enabled"]
+        assert res["prefix"]["hit_tokens"] > 0
+
+    def test_durable_journal_with_prefix_cache_survives_sigkill(
+            self, model_params, tmp_path):
+        """THE satellite pin: a journaled run with the prefix cache on
+        survives a simulated SIGKILL (only the journal file persists)
+        and the merged streams equal an unfaulted cache-off run's —
+        replayed ``prompt + prefix`` submissions rebuild and re-hit the
+        trie without perturbing a single token."""
+        model, params = model_params
+        path = str(tmp_path / "journal.jsonl")
+        want = PagedDecodeEngine(model, params, SERVE).run(_shared_trace())
+
+        factory = self._flaky_factory(model, params, serve=PSERVE)
+        with pytest.raises(RuntimeError):
+            factory().run(_shared_trace(), journal=ReplayJournal(path))
+
+        res = run_with_replay(
+            lambda: PagedDecodeEngine(model, params, PSERVE),
+            _shared_trace(), journal_path=path)
+        assert res["outputs"] == want["outputs"]
+        assert all(s == "ok" for s in res["statuses"].values())
+
+    def test_replayed_prompts_re_root_through_the_trie(self, model_params):
+        """A replayed request's prompt embeds its delivered prefix; the
+        fresh engine's prefill of that concatenation both rebuilds the
+        trie and (for requests sharing the original system prompt)
+        re-shares blocks in the NEW pool — outputs exact either way."""
+        model, params = model_params
+        want = PagedDecodeEngine(model, params, SERVE).run(
+            _shared_trace(prefix=12))
+        res = run_with_replay(
+            self._flaky_factory(model, params, fail_on_call=2, times=2,
+                                serve=PSERVE),
+            _shared_trace(prefix=12), max_restarts=3)
+        assert res["replays"] == 2
+        assert res["outputs"] == want["outputs"]
