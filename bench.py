@@ -411,7 +411,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     kernel: str | None = None,
                     kernel_ab: bool = False,
                     prefix_cache: str | None = None,
-                    prefix_tokens: int = 0) -> dict:
+                    prefix_tokens: int = 0,
+                    speculative: str | None = None,
+                    draft_k: int | None = None,
+                    spec_ab: bool = False) -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic Poisson request trace.
 
@@ -459,6 +462,19 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     block carries the measurable win — ``hit_rate``, blocks saved, and
     the pool-occupancy delta — plus a token-identity cross-check
     against the unshared arm.
+
+    Speculative decoding: ``speculative`` (--serve-speculative:
+    off|ngram|draft-model; None = the run Config's default) drafts
+    ``draft_k`` tokens per live sequence and verifies them in one
+    forward; the detail's ``speculation`` block carries the bandwidth
+    proxy (``accept_rate`` / ``mean_accepted_len`` / ``steps_saved`` =
+    emitted tokens minus verify forwards — full KV-streaming passes
+    avoided), and a speculative run (no journal) also replays the trace
+    through a speculation-OFF engine for a token-identity cross-check.
+    ``spec_ab`` additionally TIMES that off arm (own warmup, own
+    zero-recompile probe) and emits the wall-clock ``spec_speedup``
+    line — mirroring ``kernel_ab``, and mutually exclusive with it
+    (one comparison, one variable).
     """
     import dataclasses as dc
     import time
@@ -490,8 +506,22 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     max_slots = max_slots if max_slots is not None else cfg.serve_max_slots
     block_size = (block_size if block_size is not None
                   else cfg.serve_block_size)
+    spec_mode = (speculative if speculative is not None
+                 else cfg.serve_speculative)
     bcfg = dc.replace(bert.BERT_TINY if tiny else bert.BERT_BASE,
                       dtype=cfg.compute_dtype)
+    if spec_mode != "off":
+        # the speculative workload runs on ROPE positions: an untrained
+        # model with per-position learned embeddings emits an aperiodic
+        # stream (~every token unique — measured), which is the
+        # degenerate worst case for any drafter and says nothing about
+        # the machinery; rope dynamics are position-relative, so the
+        # same untrained model falls into the recurrent/templated
+        # regime speculation targets.  BOTH arms (speculative and the
+        # off control) share this model, so the token-identity contract
+        # is internal to the run, and speculative-off runs keep the
+        # historical learned-position trace byte-for-byte.
+        bcfg = dc.replace(bcfg, pos_kind="rope")
     model = gpt.CausalLm(bcfg)
     params = model.init(jax.random.key(0))
     rng = np.random.default_rng(seed)
@@ -521,7 +551,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     serve = ServeConfig.from_config(
         cfg, num_blocks=pool_blocks, block_size=block_size,
         max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
-        prefix_cache=prefix_cache,
+        prefix_cache=prefix_cache, speculative=speculative,
+        draft_k=draft_k,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
     if kernel_ab and journal is not None:
@@ -533,6 +564,23 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                          "control arm; combining it with "
                          "--serve-kernel-ab would change two variables "
                          "in one comparison — pick one")
+    if spec_ab and serve.speculative == "off":
+        raise ValueError("--serve-spec-ab compares speculative decoding "
+                         "against its off arm; pick a drafter with "
+                         "--serve-speculative ngram|draft-model")
+    if spec_ab and journal is not None:
+        raise ValueError("--serve-spec-ab is a measurement (two timed "
+                         "arms); the journaled serve mode is not — pick "
+                         "one")
+    if spec_ab and kernel_ab:
+        raise ValueError("--serve-spec-ab and --serve-kernel-ab each "
+                         "replay the trace through their own control "
+                         "arm; one comparison, one variable — pick one")
+    if kernel_ab and serve.speculative != "off":
+        raise ValueError("--serve-speculative adds its own off control "
+                         "arm; combining it with --serve-kernel-ab "
+                         "would change two variables in one comparison "
+                         "— pick one")
 
     def _roofline(resolved_kernel: str) -> dict:
         """Bytes-per-decode-token ESTIMATE for both lowerings, from the
@@ -588,6 +636,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "prefix": res.get("prefix"),
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
+            "speculation": res.get("speculation"),
+            "serve_speculative": serve.speculative,
+            "serve_draft_k": serve.draft_k,
             "peak_blocks_in_use": res.get("peak_blocks_in_use"),
             "peak_live_blocks": res.get("peak_live_blocks"),
             "serving_tokens_per_sec": res["tokens_per_sec"],
@@ -699,6 +750,41 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "token_identical_vs_off": off["outputs"] == cb["outputs"],
         }
 
+    spec_detail = cb["speculation"]
+    spec_ab_detail = None
+    if serve.speculative != "off":
+        # the speculation-off control arm: SAME trace, same (rope)
+        # model, drafting disabled — its outputs pin the token-identity
+        # contract (greedy decode must not notice the drafter), and
+        # under --serve-spec-ab its timed rate is the denominator of
+        # the wall-clock speedup line.  Warmed untimed first, exactly
+        # like the kernel A/B and prefix control arms.
+        eng_off = PagedDecodeEngine(
+            model, params, dc.replace(serve, speculative="off"))
+        eng_off.run(trace())
+        w_off = eng_off.compile_counts()
+        eng_off.reset()
+        off = eng_off.run(trace())
+        s_off = eng_off.compile_counts()
+        spec_detail = {
+            **cb["speculation"],
+            "token_identical_vs_off": off["outputs"] == cb["outputs"],
+        }
+        if spec_ab:
+            arms = {"speculative": cb["tokens_per_sec"],
+                    "off": off["tokens_per_sec"]}
+            spec_ab_detail = {
+                "arms": arms,
+                # >1 = speculation beats vanilla decode on wall clock
+                "spec_speedup_vs_off": (
+                    round(arms["speculative"] / arms["off"], 3)
+                    if arms["off"] > 0 else None),
+                "ab_zero_recompile": (
+                    w_off == s_off
+                    if all(v is not None for v in
+                           {**w_off, **s_off}.values()) else None),
+            }
+
     # -- static-batch baseline: generate() on arrival-order groups of
     # max_slots, each padded to its longest prompt and decoded to its
     # longest output budget, one shared cache capacity per batch --
@@ -741,6 +827,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "prefix": prefix_detail,
         "serve_prefix_cache": serve.prefix_cache,
         "serve_prefix_tokens": prefix_tokens,
+        "speculation": spec_detail,
+        "spec_ab": spec_ab_detail,
+        "serve_speculative": serve.speculative,
+        "serve_draft_k": serve.draft_k,
         "peak_blocks_in_use": cb["peak_blocks_in_use"],
         "peak_live_blocks": cb["peak_live_blocks"],
         "serving_tokens_per_sec": cb["tokens_per_sec"],
@@ -1080,6 +1170,21 @@ def _stale_score(args, d: dict, item=None):
                 (getattr(args, "serve_prefix_cache", None)
                  or serve_defaults.serve_prefix_cache):
             return None
+        # speculative decoding changes the model family (rope workload)
+        # AND the step structure — a record under a different drafter
+        # config is a different number; a spec A/B request is two live
+        # arms by definition (absent keys on old records read as the
+        # pre-speculation defaults: off, no A/B)
+        if getattr(args, "serve_spec_ab", False) or d.get("spec_ab"):
+            return None
+        want_spec = (getattr(args, "serve_speculative", None)
+                     or serve_defaults.serve_speculative)
+        if d.get("serve_speculative", "off") != want_spec:
+            return None
+        if want_spec != "off" and d.get("serve_draft_k") != \
+                (getattr(args, "serve_draft_k", None)
+                 or serve_defaults.serve_draft_k):
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1221,6 +1326,16 @@ def _report(args, d: dict, stale: bool = False) -> int:
             # and the pool occupancy it saved vs the cache-off arm
             out["prefix_hit_rate"] = pref.get("hit_rate")
             out["prefix_blocks_saved"] = pref.get("blocks_saved_peak")
+        spec = d.get("speculation")
+        if spec and spec.get("enabled"):
+            # the bandwidth proxy the drafter exists for: accepted
+            # fraction and full KV-streaming passes avoided
+            out["spec_accept_rate"] = spec.get("accept_rate")
+            out["spec_steps_saved"] = spec.get("steps_saved")
+        sab = d.get("spec_ab")
+        if sab is not None:
+            # THE wall-clock line the spec A/B flag exists for
+            out["spec_speedup"] = sab.get("spec_speedup_vs_off")
         _print_json(out)
         return 0
     if args.mode == "decode":
@@ -1417,6 +1532,28 @@ def main(argv=None) -> int:
                          "prefix workload the prefix cache exists for "
                          "(0 = all-unique prompts, the historical "
                          "trace)")
+    ap.add_argument("--serve-speculative",
+                    choices=["off", "ngram", "draft-model"], default=None,
+                    help="serving mode: speculative decoding — draft k "
+                         "tokens (ngram self-draft or a tiny draft "
+                         "model over its own paged pool) and verify "
+                         "them in ONE forward, emitting only the "
+                         "argmax-matching prefix (token-identical to "
+                         "off by construction).  Runs the workload on "
+                         "rope positions so the untrained model's "
+                         "greedy stream is recurrent — the templated-"
+                         "traffic stand-in (default: the run Config's "
+                         "serve_speculative)")
+    ap.add_argument("--serve-draft-k", type=int, default=None,
+                    help="serving mode: speculative draft window — "
+                         "tokens proposed per verify forward; >= 1 "
+                         "(default: the run Config's serve_draft_k)")
+    ap.add_argument("--serve-spec-ab", action="store_true",
+                    help="serving mode: TIME the speculation-off "
+                         "control arm too (own warmup, own zero-"
+                         "recompile probe) and emit the spec_speedup "
+                         "line — mirrors --serve-kernel-ab and is "
+                         "mutually exclusive with it")
     ap.add_argument("--serve-tiny", action="store_true",
                     help="serving mode: BERT_TINY model geometry — the "
                          "smoke/fault-injection configuration, not a "
@@ -1508,6 +1645,27 @@ def main(argv=None) -> int:
         ap.error("--serve-prefix-cache on already adds its own cache-off "
                  "control arm; combine with --serve-kernel-ab one at a "
                  "time so each comparison has a single variable")
+    if args.serve_draft_k is not None and args.serve_draft_k < 1:
+        ap.error(f"--serve-draft-k must be >= 1, got "
+                 f"{args.serve_draft_k}")
+    if (args.serve_speculative is not None
+            or args.serve_draft_k is not None or args.serve_spec_ab) \
+            and args.mode != "serving":
+        ap.error("--serve-speculative/--serve-draft-k/--serve-spec-ab "
+                 "shape the serving trace; other modes would silently "
+                 "ignore them")
+    if args.serve_spec_ab and args.serve_kernel_ab:
+        ap.error("--serve-spec-ab and --serve-kernel-ab each replay the "
+                 "trace through their own control arm; one comparison, "
+                 "one variable — pick one")
+    if args.serve_spec_ab and args.serve_speculative in (None, "off"):
+        ap.error("--serve-spec-ab compares speculative decoding against "
+                 "its off arm; pick a drafter with --serve-speculative "
+                 "ngram|draft-model")
+    if args.serve_speculative not in (None, "off") and args.serve_kernel_ab:
+        ap.error("--serve-speculative already adds its own off control "
+                 "arm; combine with --serve-kernel-ab one at a time so "
+                 "each comparison has a single variable")
     if args.prng != "threefry" and args.mode != "train":
         ap.error("--prng shapes the training dropout stream; decode/"
                  "allreduce modes have no dropout and would silently "
@@ -1581,7 +1739,10 @@ def main(argv=None) -> int:
                             kernel=args.serve_kernel,
                             kernel_ab=args.serve_kernel_ab,
                             prefix_cache=args.serve_prefix_cache,
-                            prefix_tokens=args.serve_prefix_tokens)
+                            prefix_tokens=args.serve_prefix_tokens,
+                            speculative=args.serve_speculative,
+                            draft_k=args.serve_draft_k,
+                            spec_ab=args.serve_spec_ab)
         return _report(args, r)
 
     if args.mode == "decode":

@@ -156,6 +156,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "write on divergence, LRU trie eviction under "
                         "pool pressure; serving/prefix_cache); off "
                         "preserves the unshared behavior byte-for-byte")
+    p.add_argument("--serve-speculative",
+                   choices=["off", "ngram", "draft-model"],
+                   default=d.serve_speculative,
+                   help="serving: speculative decoding — ngram drafts "
+                        "from the sequence's own earlier tokens, "
+                        "draft-model runs a tiny CausalLm over its own "
+                        "paged pool; k drafted tokens verify in ONE "
+                        "batched forward and only the argmax-matching "
+                        "prefix is emitted, so greedy outputs stay "
+                        "token-identical to off (the byte-for-byte "
+                        "one-token loop; serving/speculative)")
+    p.add_argument("--serve-draft-k", type=int, default=d.serve_draft_k,
+                   help="serving: speculative draft window — tokens "
+                        "proposed per verify forward (dispatch width "
+                        "draft_k + 1); >= 1")
     p.add_argument("--serve-deadline-ms", type=float,
                    default=d.serve_deadline_ms,
                    help="serving: default per-request TTL from arrival; "
@@ -223,6 +238,8 @@ def config_from_args(args) -> Config:
         serve_max_seq_len=args.serve_max_seq_len,
         serve_kernel=args.serve_kernel,
         serve_prefix_cache=args.serve_prefix_cache,
+        serve_speculative=args.serve_speculative,
+        serve_draft_k=args.serve_draft_k,
         serve_deadline_ms=args.serve_deadline_ms,
         serve_queue_depth=args.serve_queue_depth,
         serve_max_evictions=args.serve_max_evictions,
@@ -282,6 +299,12 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"bad --serve-prefix-cache {config.serve_prefix_cache!r}: "
             f"must be off|on")
+    if config.serve_speculative not in ("off", "ngram", "draft-model") \
+            or config.serve_draft_k < 1:
+        raise SystemExit(
+            f"bad --serve-speculative config: mode "
+            f"{config.serve_speculative!r} (off|ngram|draft-model), "
+            f"draft-k {config.serve_draft_k} (>= 1)")
     if (config.serve_deadline_ms is not None
             and config.serve_deadline_ms <= 0) \
             or (config.serve_queue_depth is not None

@@ -117,6 +117,19 @@ class Config:
                                   # on-write, LRU trie eviction under
                                   # pool pressure); "off" preserves the
                                   # unshared behavior byte-for-byte
+    serve_speculative: str = "off"  # speculative decoding: "ngram"
+                                  # (n-gram self-draft, zero extra
+                                  # model), "draft-model" (tiny-model
+                                  # drafter over its own paged pool);
+                                  # drafts verify in ONE batched
+                                  # forward and only the argmax-
+                                  # matching prefix is emitted, so
+                                  # greedy outputs are token-identical
+                                  # to "off" (which preserves the one-
+                                  # token decode loop byte-for-byte)
+    serve_draft_k: int = 4        # draft window: tokens proposed per
+                                  # verify forward (dispatch width is
+                                  # draft_k + 1)
     # fault-tolerance policy (serving/engine.ServeConfig; None = off)
     serve_deadline_ms: Optional[float] = None  # default per-request TTL
                                   # from arrival; expired work fails

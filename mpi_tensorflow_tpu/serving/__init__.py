@@ -1,6 +1,6 @@
 """Continuous-batching serving subsystem (Orca / vLLM lineage).
 
-Four cooperating layers, host-side policy over device-side math:
+Six cooperating layers, host-side policy over device-side math:
 
 - ``paged_cache``  — fixed device pool of KV blocks + the refcounted
                      host block allocator; memory scales with LIVE
@@ -17,8 +17,17 @@ Four cooperating layers, host-side policy over device-side math:
                      admission control (feasibility check, bounded
                      queue, deadlines), livelock/starvation guards, and
                      a structured terminal status for every request.
-- ``engine``       — chunked prefill + single-token decode steps at a
-                     small fixed set of bucketed shapes (powers of two),
+- ``speculative``  — speculative-decoding drafters (Leviathan et al.
+                     lineage): an n-gram self-draft and a tiny-model
+                     drafter over its own paged pool propose k tokens
+                     that the engine verifies in ONE batched forward,
+                     accepting the longest argmax-matching prefix —
+                     greedy outputs stay token-identical by
+                     construction while one KV-streaming pass covers
+                     up to k+1 emitted tokens.
+- ``engine``       — chunked prefill + single-token decode (or
+                     (k+1)-token speculative verify) steps at a small
+                     fixed set of bucketed shapes (powers of two),
                      with the block pool donated through every dispatch
                      so steady-state serving updates the cache in place
                      and never recompiles after bucket warmup; graceful
@@ -43,3 +52,5 @@ from mpi_tensorflow_tpu.serving.recovery import (  # noqa: F401
     ReplayJournal, run_with_replay)
 from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401
     Request, RejectedRequest, Scheduler, TERMINAL_STATUSES)
+from mpi_tensorflow_tpu.serving.speculative import (  # noqa: F401
+    Drafter, DraftModelDrafter, NgramDrafter, make_drafter)

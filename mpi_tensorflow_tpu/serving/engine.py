@@ -66,6 +66,17 @@ class ServeConfig:
                                   # trie eviction under pressure);
                                   # "off" preserves byte-for-byte the
                                   # unshared behavior
+    speculative: str = "off"      # speculative decoding (--serve-
+                                  # speculative): "ngram" = n-gram
+                                  # self-draft, "draft-model" = tiny-
+                                  # model drafter over its own paged
+                                  # pool (serving/speculative); "off"
+                                  # keeps the one-token decode loop
+                                  # byte-for-byte
+    draft_k: int = 4              # draft window (--serve-draft-k):
+                                  # tokens proposed per verify forward;
+                                  # the verify dispatch width is k+1
+                                  # and a step emits 1..k+1 tokens
     # --- fault-tolerance policy (None = feature off / unbounded) ---
     deadline_ms: Optional[float] = None   # default per-request TTL from
                                   # arrival; expired work fails with
@@ -95,6 +106,8 @@ class ServeConfig:
                     max_seq_len=config.serve_max_seq_len,
                     kernel=config.serve_kernel,
                     prefix_cache=config.serve_prefix_cache,
+                    speculative=config.serve_speculative,
+                    draft_k=config.serve_draft_k,
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
                     max_evictions=config.serve_max_evictions,
@@ -119,6 +132,13 @@ class ServeConfig:
             raise ValueError(
                 f"serve prefix cache must be off|on, "
                 f"got {self.prefix_cache!r}")
+        if self.speculative not in ("off", "ngram", "draft-model"):
+            raise ValueError(
+                f"serve speculative must be off|ngram|draft-model, "
+                f"got {self.speculative!r}")
+        if self.draft_k < 1:
+            raise ValueError(
+                f"serve draft_k must be >= 1, got {self.draft_k}")
         if (self.deadline_ms is not None and self.deadline_ms <= 0) \
                 or (self.queue_depth is not None and self.queue_depth < 1) \
                 or (self.max_evictions is not None
@@ -158,10 +178,12 @@ class PagedDecodeEngine:
     top once the deterministic path is pinned.
     """
 
-    def __init__(self, model, params, serve: ServeConfig):
+    def __init__(self, model, params, serve: ServeConfig, *,
+                 draft_model=None, draft_params=None):
         import jax
 
         from mpi_tensorflow_tpu.ops import paged_attention as paged_ops
+        from mpi_tensorflow_tpu.serving import speculative as spec_lib
 
         self.model = model
         self.params = params
@@ -191,6 +213,14 @@ class PagedDecodeEngine:
         self._cow_fn = jax.jit(
             self._cow_impl,
             donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
+        # speculative decoding: the verify step runs pending + k draft
+        # tokens through one forward (chunked-prefill math, decode-style
+        # batching); the drafter is a host-side policy object built ONCE
+        # so its jit cache (draft-model mode) survives reset()
+        self._verify_fn = jax.jit(self._verify_impl, donate_argnums=donate)
+        self.drafter = spec_lib.make_drafter(
+            serve.speculative, serve, model,
+            draft_model=draft_model, draft_params=draft_params)
         self.reset()
         if self.prefix_cache is not None:
             # pre-pay the CoW copy's single compile with a null-block
@@ -201,6 +231,17 @@ class PagedDecodeEngine:
 
             z = jnp.asarray(0, jnp.int32)
             self.pools = self._cow_fn(self.pools, z, z)
+        if self.drafter is not None:
+            # pre-warm the verify dispatch at EVERY (slot bucket, table
+            # bucket) x width-(k+1) shape, plus the drafter's own chunk
+            # buckets: how many tokens a verify step emits — and hence
+            # which buckets later steps hit — depends on ACCEPTANCE,
+            # i.e. on token content, so a warmup trace replay cannot be
+            # trusted to visit every bucket the timed trace will.  The
+            # zero-recompile contract must not hinge on content luck.
+            self._prewarm_verify()
+            if hasattr(self.drafter, "warmup"):
+                self.drafter.warmup()
 
     def reset(self) -> None:
         """Fresh pools/scheduler; jit caches (and their warmed bucket
@@ -217,12 +258,17 @@ class PagedDecodeEngine:
         self.prefix_cache = (
             prefix_lib.PrefixCache(self.allocator, self.serve.block_size)
             if self.serve.prefix_cache == "on" else None)
+        if self.drafter is not None:
+            # the draft pool indexes device state that resets with the
+            # engine's own pools (crash recovery rebuilds both)
+            self.drafter.reset()
         self.sched = sched_lib.Scheduler(
             self.allocator, self.serve.max_slots, self.serve.block_size,
             self.serve.max_blocks_per_seq,
             queue_depth=self.serve.queue_depth,
             max_evictions=self.serve.max_evictions,
-            prefix_cache=self.prefix_cache)
+            prefix_cache=self.prefix_cache,
+            on_terminal=self._on_terminal)
         # pool-occupancy high-water marks: raw = every referenced block
         # (includes trie-retained blocks, which are reclaimable cache);
         # live = distinct blocks mapped by live sequences — the
@@ -244,6 +290,18 @@ class PagedDecodeEngine:
         # while queued — a stale entry must not prefill the NEW occupant
         self._prefill_queue: List[tuple] = []
         self.dispatch_shapes: set = set()
+
+    def _on_terminal(self, req, status: str) -> None:
+        """THE per-request exit hook (installed on every scheduler this
+        engine builds): release the drafter's per-request state, then
+        forward to the replay journal when one is attached — chaining
+        here (instead of run() overwriting ``sched.on_terminal``) keeps
+        the tok-then-end durable ordering AND the draft-pool lifecycle
+        in one place."""
+        if self.drafter is not None:
+            self.drafter.release(req.id)
+        if self._journal is not None:
+            self._journal.record_end(req, status)
 
     # ---------------- jitted device steps ----------------
 
@@ -283,6 +341,58 @@ class PagedDecodeEngine:
         copy reuses the one compiled program."""
         return [{"k": p["k"].at[dst].set(p["k"][src]),
                  "v": p["v"].at[dst].set(p["v"][src])} for p in pools]
+
+    def _verify_impl(self, params, pools, tokens, lengths, n_valid,
+                     tables):
+        """The speculative VERIFY dispatch: row ``b`` feeds its pending
+        token plus its draft (``n_valid[b]`` real lanes of the fixed
+        ``draft_k + 1`` width) at positions ``lengths[b] + lane``
+        through ONE forward — the chunked-prefill math at decode-style
+        batching.  Returns the greedy argmax at EVERY lane (``(B, W)``):
+        lane ``i``'s token is what vanilla decode would emit after
+        consuming the first ``i`` draft tokens, which is exactly the
+        chain the host-side acceptance walk compares the draft against.
+        Padding lanes (row slack or bucket slack) scatter into the null
+        block and their argmax is discarded on host."""
+        import jax.numpy as jnp
+
+        from mpi_tensorflow_tpu.ops.paged_attention import NULL_BLOCK
+
+        W = tokens.shape[1]
+        live = tables[:, 0] != NULL_BLOCK
+        valid = (jnp.arange(W)[None] < n_valid[:, None]) & live[:, None]
+        logits, pools = self.model.forward_paged(
+            params, tokens, pools, tables, lengths, valid=valid,
+            kernel=self.kernel)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
+
+    def _prewarm_verify(self) -> None:
+        """Compile the verify dispatch at every (slot bucket, table
+        bucket) it can ever run at — all-null tables, zero valid lanes,
+        so nothing real is touched.  Unlike the decode path (whose
+        bucket visits depend only on the trace ENVELOPE a warmup replay
+        reproduces), verify-step bucket visits depend on acceptance —
+        token content — so the contract is paid up front."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        W = self.serve.draft_k + 1
+        Bb = 1
+        while True:
+            NBb = 1
+            while True:
+                toks, self.pools = self._verify_fn(
+                    self.params, self.pools,
+                    jnp.asarray(np.zeros((Bb, W), np.int32)),
+                    jnp.asarray(np.zeros((Bb,), np.int32)),
+                    jnp.asarray(np.zeros((Bb,), np.int32)),
+                    jnp.asarray(np.zeros((Bb, NBb), np.int32)))
+                if NBb >= self.serve.max_blocks_per_seq:
+                    break
+                NBb = min(NBb * 2, self.serve.max_blocks_per_seq)
+            if Bb >= self.serve.max_slots:
+                break
+            Bb = min(Bb * 2, self.serve.max_slots)
 
     # ---------------- host-side step assembly ----------------
 
@@ -410,6 +520,9 @@ class PagedDecodeEngine:
             (slot, self.sched.slots[slot]) for slot in admitted)
         emitted = self._advance_prefill()
 
+        if self.drafter is not None:
+            return self._step_verify(emitted)
+
         live = []
         for slot in self.sched.live_slots():
             seq = self.sched.slots[slot]
@@ -463,6 +576,118 @@ class PagedDecodeEngine:
             self.sched.record_token(slot, tok, self.serve.eos_id)
         return emitted
 
+    def _step_verify(self, emitted: List[Tuple[int, int]]) \
+            -> List[Tuple[int, int]]:
+        """The speculative replacement for the decode phase: draft up
+        to ``draft_k`` tokens per live slot, verify every slot's window
+        in ONE batched forward, accept the longest argmax-matching
+        draft prefix plus the model's own token at the first mismatch,
+        then roll back the blocks the rejected tail was parked in.
+
+        Token identity with ``--serve-speculative off`` holds by
+        construction: lane ``i`` of the verify output is the argmax
+        over exactly the context vanilla decode would have at that
+        position, and only argmax-chain-consistent tokens are emitted.
+        A slot whose drafter proposes nothing rides the same dispatch
+        with one valid lane — an exact one-token decode step."""
+        import jax.numpy as jnp
+
+        serve = self.serve
+        bs = serve.block_size
+        cap = serve.max_blocks_per_seq * bs
+        live: List[int] = []
+        drafts: dict = {}
+        for slot in self.sched.live_slots():
+            seq = self.sched.slots[slot]
+            if seq is None or seq.prefilled < len(seq.request.prompt):
+                continue            # mid-prefill: not in the decode pool
+            if not self.sched.ensure_block(slot):
+                self.sched.fail_live(slot, "rejected")
+                continue
+            # draft window, bounded so a full accept can neither bust
+            # the request's budget (k <= remaining - 1: at most
+            # ``remaining`` tokens emitted) nor the table capacity
+            remaining = seq.request.max_new_tokens - len(seq.generated)
+            k = min(serve.draft_k, remaining - 1, cap - seq.length)
+            draft: List[int] = []
+            if k > 0:
+                ctx = list(seq.request.prompt) + seq.generated
+                draft = list(self.drafter.draft(
+                    seq.request.id, ctx, k))[:k]
+            if draft:
+                # cover the whole window's writes [length-1, length+|d|)
+                # with free blocks only — speculation never preempts
+                covered = self.sched.extend_for(slot,
+                                                seq.length + len(draft))
+                draft = draft[:max(0, covered - seq.length)]
+            if not self._ensure_private(slot, seq.length - 1,
+                                        seq.length + len(draft)):
+                self.sched.fail_live(slot, "rejected")
+                continue
+            live.append(slot)
+            drafts[slot] = draft
+        # eviction inside ensure_block/CoW may have retired a later slot
+        live = [s for s in live if self.sched.slots[s] is not None]
+        self._track_occupancy()
+        if not live:
+            return emitted
+        self._progressed = True
+
+        W = serve.draft_k + 1
+        Bb = _bucket(len(live), serve.max_slots)
+        nb = max(len(self.sched.slots[s].block_ids) for s in live)
+        NBb = _bucket(nb, serve.max_blocks_per_seq)
+        tokens = np.zeros((Bb, W), np.int32)
+        lengths = np.zeros((Bb,), np.int32)
+        n_valid = np.zeros((Bb,), np.int32)
+        tables = np.zeros((Bb, NBb), np.int32)
+        for j, slot in enumerate(live):
+            seq = self.sched.slots[slot]
+            row = [self._last_token[slot]] + drafts[slot]
+            tokens[j, :len(row)] = row
+            n_valid[j] = len(row)
+            lengths[j] = seq.length - 1
+            tables[j] = self._table_row(seq, NBb)
+        self.dispatch_shapes.add(("verify", Bb, NBb))
+        out, self.pools = self._verify_fn(
+            self.params, self.pools, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(n_valid),
+            jnp.asarray(tables))
+        out = np.asarray(out)
+
+        counters = self.sched.counters
+        for j, slot in enumerate(live):
+            seq = self.sched.slots[slot]
+            draft = drafts[slot]
+            # longest exact-match prefix of the draft, then the model's
+            # own token at the first mismatch (or after a full accept)
+            n_acc = 0
+            while n_acc < len(draft) and int(out[j, n_acc]) == draft[n_acc]:
+                n_acc += 1
+            emit = draft[:n_acc] + [int(out[j, n_acc])]
+            if serve.eos_id is not None and serve.eos_id in emit:
+                # nothing streams past EOS — and nothing past it may be
+                # journaled either (the journal holds accepted tokens
+                # only, and EOS terminates acceptance)
+                emit = emit[:emit.index(serve.eos_id) + 1]
+            counters["spec_drafted"] += len(draft)
+            counters["spec_accepted"] += min(n_acc, len(emit))
+            counters["spec_verify_forwards"] += 1
+            counters["spec_emitted"] += len(emit)
+            self._last_token[slot] = emit[-1]
+            rid = seq.request.id
+            for tok in emit:
+                emitted.append((rid, tok))
+                if self._journal is not None:
+                    self._journal.record_token(rid, tok)
+            self.sched.record_tokens(slot, emit, serve.eos_id)
+            if self.sched.slots[slot] is seq:
+                # rollback: the rejected tail's phantom KV writes sit in
+                # blocks past the accepted length — release them so the
+                # pool never retains entries no accepted token owns
+                self.sched.rollback_blocks(slot, seq.length)
+        return emitted
+
     # ---------------- request loop ----------------
 
     def run(self, requests: List[sched_lib.Request],
@@ -497,9 +722,10 @@ class PagedDecodeEngine:
                         dataclasses.replace(
                             r, deadline=r.arrival + serve.deadline_ms / 1e3)
                         for r in requests]
+        # terminal routing (journal record_end + drafter release) runs
+        # through the engine's chained _on_terminal hook, already
+        # installed on the scheduler at reset()
         self._journal = journal
-        if journal is not None:
-            self.sched.on_terminal = journal.record_end
         pending = sorted(requests, key=lambda r: r.arrival)
         token_times: dict = {}                  # request id -> [latency]
         last_emit: dict = {}                    # request id -> stamp
@@ -565,8 +791,12 @@ class PagedDecodeEngine:
                     time.sleep(delay)
         elapsed = time_fn() - t0
         # pool-leak invariant: every terminal request released its
-        # blocks; only the prefix trie's own references may remain
+        # blocks; only the prefix trie's own references may remain —
+        # and the draft pool (every request terminal => every draft
+        # state released by the terminal hook) must have drained too
         self.sched.check_quiescent()
+        if self.drafter is not None:
+            self.drafter.check_quiescent()
         outputs = {s.request.id: list(s.generated)
                    for s in self.sched.finished}
         total = sum(len(v) for v in outputs.values())
@@ -589,6 +819,7 @@ class PagedDecodeEngine:
             },
             "kernel": self.kernel,
             "prefix": self.prefix_block(),
+            "speculation": self.speculation_block(),
             "peak_blocks_in_use": self.peak_blocks_in_use,
             "peak_live_blocks": self.peak_live_blocks,
             "tokens": total,
@@ -613,6 +844,17 @@ class PagedDecodeEngine:
             trie_blocks=(self.prefix_cache.num_blocks
                          if self.prefix_cache is not None else 0))
 
+    def speculation_block(self) -> dict:
+        """Canonical speculative-decoding accounting block
+        (utils/metrics_writer.speculation_block — shared with the
+        recovery supervisor's cross-attempt merge and bench JSON)."""
+        from mpi_tensorflow_tpu.utils.metrics_writer import \
+            speculation_block
+
+        return speculation_block(
+            self.sched.counters, enabled=self.drafter is not None,
+            mode=self.serve.speculative, draft_k=self.serve.draft_k)
+
     def compile_counts(self) -> dict:
         """Live jit-cache entry counts — THE zero-recompile probe: a
         steady-state serving window must not grow either number.  A
@@ -625,6 +867,13 @@ class PagedDecodeEngine:
                 return int(fn._cache_size())
             except Exception:
                 return None
-        return {"decode": size(self._decode_fn),
-                "prefill": size(self._prefill_fn),
-                "cow": size(self._cow_fn)}
+        out = {"decode": size(self._decode_fn),
+               "prefill": size(self._prefill_fn),
+               "cow": size(self._cow_fn),
+               "verify": size(self._verify_fn)}
+        if self.drafter is not None:
+            # a drafter's own jitted dispatches are inside the steady-
+            # state loop too — the contract covers them like the
+            # engine's (Drafter.compile_counts; {} for host-only ones)
+            out.update(self.drafter.compile_counts())
+        return out

@@ -277,4 +277,15 @@ def run_with_replay(make_engine: Callable[[], "object"],
         res["prefix"] = prefix_block(
             totals, enabled=res["prefix"]["enabled"],
             trie_blocks=res["prefix"]["trie_blocks"])
+    if "speculation" in res:
+        # speculative-decoding accounting merged across attempts the
+        # same way: drafts verified before a crash were real bandwidth
+        # savings even though the replay regenerates their tokens
+        from mpi_tensorflow_tpu.utils.metrics_writer import \
+            speculation_block
+
+        res["speculation"] = speculation_block(
+            totals, enabled=res["speculation"]["enabled"],
+            mode=res["speculation"]["mode"],
+            draft_k=res["speculation"]["draft_k"])
     return res

@@ -340,6 +340,42 @@ class Scheduler:
             seq.block_ids.append(b)
         return True
 
+    def extend_for(self, slot: int, total_tokens: int) -> int:
+        """Opportunistically grow the slot's table to cover
+        ``total_tokens`` cache entries (a speculative draft window
+        writes up to k tokens past the pending one) WITHOUT preemption
+        or trie eviction: speculation is a bandwidth optimization, and
+        letting it evict live sequences or cached prefixes would trade
+        real work for guessed work.  Takes only free blocks; returns
+        the entries the table now covers — the caller shrinks its draft
+        to fit."""
+        seq = self.slots[slot]
+        want = min(blocks_for(total_tokens, self.block_size),
+                   self.max_blocks_per_seq)
+        extra = want - len(seq.block_ids)
+        if extra > 0 and self.allocator.can_alloc(extra):
+            seq.block_ids.extend(self.allocator.alloc(extra))
+        return len(seq.block_ids) * self.block_size
+
+    def rollback_blocks(self, slot: int, keep_tokens: int) -> int:
+        """Release the slot's trailing blocks beyond what
+        ``keep_tokens`` cache entries need — the draft-rollback path: a
+        verify step that rejected draft tokens returns the blocks that
+        existed only to hold their (phantom) KV writes, so the pool
+        never retains entries no accepted token owns.  Safe with prefix
+        sharing: trailing blocks past the live length are exclusive by
+        construction (admission-mapped shared blocks all precede it),
+        and the refcounted release would protect a sharer anyway.
+        Returns the number of blocks released."""
+        seq = self.slots[slot]
+        keep = max(blocks_for(keep_tokens, self.block_size), 1)
+        if len(seq.block_ids) <= keep:
+            return 0
+        victims = seq.block_ids[keep:]
+        del seq.block_ids[keep:]
+        self.allocator.release(victims)
+        return len(victims)
+
     def _evict_youngest(self, protect: Optional[int],
                         younger_than: Optional[float] = None,
                         requeue_pos: int = 0) -> bool:
@@ -398,6 +434,23 @@ class Scheduler:
             self.finished.append(seq)
             self.slots[slot] = None
             self._terminal(seq.request, "ok")
+
+    def record_tokens(self, slot: int, tokens: List[int],
+                      eos_id: Optional[int] = None) -> int:
+        """Multi-token append — the speculative-decoding extension of
+        the one-token-per-step contract: a verify step emits a VARIABLE
+        number of tokens per sequence (accepted draft prefix + the
+        model's own correction).  Stops the moment the sequence
+        finishes (EOS or budget recycles the slot mid-list); returns
+        how many tokens were recorded."""
+        seq = self.slots[slot]
+        n = 0
+        for t in tokens:
+            if self.slots[slot] is not seq:
+                break
+            self.record_token(slot, t, eos_id)
+            n += 1
+        return n
 
     # ---------------- failure / drain surface ----------------
 

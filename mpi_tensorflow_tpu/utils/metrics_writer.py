@@ -120,6 +120,37 @@ def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0) -> dict:
     }
 
 
+def speculation_block(counters, *, enabled: bool, mode: str = "off",
+                      draft_k: int = 0) -> dict:
+    """Normalize scheduler/supervisor counters into the canonical
+    serving ``speculation`` (speculative decoding) accounting block —
+    one constructor shared by engine results, the recovery
+    supervisor's cross-attempt merge, and bench JSON.
+
+    ``steps_saved`` is the bandwidth proxy the feature exists for:
+    tokens emitted through the verify path minus verify forwards run —
+    i.e. how many full KV-streaming decode passes speculation avoided
+    (0 when nothing was ever accepted; vanilla decode is one forward
+    per token by definition)."""
+    drafted = int(counters.get("spec_drafted", 0))
+    accepted = int(counters.get("spec_accepted", 0))
+    forwards = int(counters.get("spec_verify_forwards", 0))
+    emitted = int(counters.get("spec_emitted", 0))
+    return {
+        "enabled": bool(enabled),
+        "mode": mode,
+        "draft_k": int(draft_k),
+        "draft_tokens": drafted,
+        "accepted_tokens": accepted,
+        "accept_rate": round(accepted / drafted, 4) if drafted else 0.0,
+        "verify_forwards": forwards,
+        "emitted_tokens": emitted,
+        "mean_accepted_len": (round(accepted / forwards, 4)
+                              if forwards else 0.0),
+        "steps_saved": emitted - forwards,
+    }
+
+
 def write_faults(writer: MetricsWriter, counters, step: int = 0,
                  prefix: str = "serving/faults/") -> dict:
     """Stream the normalized faults block through a MetricsWriter (one

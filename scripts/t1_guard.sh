@@ -23,11 +23,23 @@
 # retry loop): the merged count so far is emitted with rc 139 so the
 # flake stays visible instead of masquerading as green or red.
 #
+# The same merge covers a BUDGET overflow (timeout kill, rc 124): a
+# run cut off by the wall-clock cap also ends summary-less, and the
+# rerun picks up from the in-flight file with the outcomes before it
+# credited exactly once.  The suite keeps growing (PR 5 added
+# tests/test_speculative.py, ~2.5 min of parity/replay pins that the
+# dynamic `tests/` collection folds straight into the dot stream), so
+# the per-run budget is tunable: T1_BUDGET=<seconds> (default 870, the
+# ROADMAP command's cap) applies to each of the two runs.
+#
 # Usage: scripts/t1_guard.sh            # the ROADMAP tier-1 invocation
 #        scripts/t1_guard.sh tests/ -m 'not slow'   # custom args
+#        T1_BUDGET=1200 scripts/t1_guard.sh         # grown suite
 
 set -u
 cd "$(dirname "$0")/.."
+
+T1_BUDGET=${T1_BUDGET:-870}
 
 PYTEST_ARGS=("$@")
 if [ ${#PYTEST_ARGS[@]} -eq 0 ]; then
@@ -60,7 +72,7 @@ dots_in() {
     --collect-only 2>/dev/null | grep -aE '^[^ ]+\.py::' > "$COLLECT" || true
 
 # 2. the real run
-"${RUN_ENV[@]}" timeout -k 10 870 python -m pytest \
+"${RUN_ENV[@]}" timeout -k 10 "$T1_BUDGET" python -m pytest \
     "${PYTEST_ARGS[@]}" "${COMMON[@]}" 2>&1 | tee "$LOG1"
 rc=${PIPESTATUS[0]}
 
@@ -141,7 +153,7 @@ done
 # same-host hazard) — a rerun that reloads the same entry dies the same
 # death.  Cold compiles for the remaining files are the price; slow
 # beats fatal.
-"${RUN_ENV[@]}" MPI_TPU_DISABLE_COMPILE_CACHE=1 timeout -k 10 870 \
+"${RUN_ENV[@]}" MPI_TPU_DISABLE_COMPILE_CACHE=1 timeout -k 10 "$T1_BUDGET" \
     python -m pytest "${REMAIN[@]}" "${OPTS[@]}" "${COMMON[@]}" \
     2>&1 | tee "$LOG2"
 rc2=${PIPESTATUS[0]}

@@ -434,6 +434,75 @@ class TestMeasureServing:
             bench.main(["--mode", "serving", "--serve-prefix-cache", "on",
                         "--serve-kernel-ab"])
 
+    def test_serving_speculative_workload_and_ab(self, monkeypatch):
+        """Speculative serving smoke: the speculation block is live and
+        self-consistent, outputs are token-identical to the off control
+        arm, zero-recompile holds (the content-dependent verify buckets
+        are pre-warmed), and --serve-spec-ab emits the speedup line.
+        The accept_rate > 0 pin lives in tests/test_speculative.py on a
+        controlled recurrent stream — a tiny Poisson trace can't
+        guarantee the drafter lands."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_serving(num_requests=4, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=12,
+                                  precision="fp32", prefix_tokens=8,
+                                  speculative="ngram", draft_k=4,
+                                  spec_ab=True)
+        sp = r["speculation"]
+        assert sp["enabled"] and sp["mode"] == "ngram"
+        assert r["serve_speculative"] == "ngram" and r["serve_draft_k"] == 4
+        assert sp["verify_forwards"] > 0
+        assert sp["emitted_tokens"] == sp["verify_forwards"] \
+            + sp["steps_saved"]
+        assert sp["token_identical_vs_off"], \
+            "speculation perturbed greedy outputs"
+        assert r["zero_recompile_steady_state"], r
+        ab = r["spec_ab"]
+        assert ab["arms"]["speculative"] > 0 and ab["arms"]["off"] > 0
+        assert ab["spec_speedup_vs_off"] is not None
+        assert ab["ab_zero_recompile"], ab
+
+    def test_serving_speculative_rejects_bad_combos(self, tmp_path):
+        """One comparison, one variable — and no silent knobs: the
+        measure_serving layer mirrors every bench argparse guard as a
+        ValueError for programmatic callers."""
+        with pytest.raises(ValueError, match="spec-ab"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  spec_ab=True)            # no drafter
+        with pytest.raises(ValueError, match="one variable"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  speculative="ngram", spec_ab=True,
+                                  kernel_ab=True)
+        with pytest.raises(ValueError, match="journal"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  speculative="ngram", spec_ab=True,
+                                  journal=str(tmp_path / "j.jsonl"))
+        with pytest.raises(ValueError, match="control arm"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  speculative="ngram", kernel_ab=True)
+        with pytest.raises(ValueError, match="draft_k"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  speculative="ngram", draft_k=0)
+
+    def test_serving_speculative_flags_guarded_at_argparse(self):
+        """--serve-speculative/--serve-draft-k/--serve-spec-ab shape
+        the serving trace; reject bad values and non-serving modes up
+        front, before any device work."""
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-draft-k", "0"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "train", "--serve-speculative", "ngram"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "decode", "--serve-spec-ab"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-speculative",
+                        "ngram", "--serve-spec-ab", "--serve-kernel-ab"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-spec-ab"])
+
 
 class TestHostIo:
     def test_hostio_smoke_reports_all_paths(self):

@@ -57,6 +57,28 @@ class TestMetricsWriter:
         assert block["deadline_exceeded"] == 0 and block["replays"] == 0
         assert all(type(v) is int for v in block.values())
 
+    def test_speculation_block_normalizes_counters(self):
+        """The canonical speculative-decoding block: rates derived from
+        the raw spec_* counters, steps_saved = emitted - forwards (full
+        KV-streaming passes avoided), zero-safe when nothing drafted —
+        the one shape engine results, the recovery supervisor's
+        cross-attempt merge, and bench JSON all share."""
+        from collections import Counter
+
+        block = metrics_writer.speculation_block(
+            Counter(spec_drafted=10, spec_accepted=6,
+                    spec_verify_forwards=4, spec_emitted=10),
+            enabled=True, mode="ngram", draft_k=4)
+        assert block["enabled"] and block["mode"] == "ngram"
+        assert block["draft_tokens"] == 10 and block["accepted_tokens"] == 6
+        assert block["accept_rate"] == 0.6
+        assert block["mean_accepted_len"] == 1.5
+        assert block["steps_saved"] == 6
+        # empty counters (off mode, or a crash before the first verify)
+        z = metrics_writer.speculation_block({}, enabled=False)
+        assert z["accept_rate"] == 0.0 and z["mean_accepted_len"] == 0.0
+        assert z["steps_saved"] == 0 and not z["enabled"]
+
     def test_write_faults_streams_one_scalar_per_counter(self, tmp_path):
         d = str(tmp_path / "m")
         with metrics_writer.MetricsWriter(d) as mw:

@@ -1,0 +1,671 @@
+"""Speculative decoding: drafters, verify-once engine, multi-token scheduler.
+
+The tier-1 anchors the ISSUE acceptance names:
+- greedy outputs in ``ngram`` and ``draft-model`` modes are
+  TOKEN-IDENTICAL to ``--serve-speculative off`` and to
+  ``CausalLm.generate`` — across shared-prefix batches, prefix-cache
+  on/off, copy-on-write inside a draft window, eviction mid-draft,
+  deadline expiry mid-draft, and SIGKILL journal replay;
+- rejected draft tokens' blocks are rolled back (the pool never retains
+  phantom entries) and ``check_quiescent()`` holds at end of run;
+- steady-state speculative serving performs zero recompiles after the
+  engine's verify pre-warm (jit cache-size probe).
+
+ROPE geometry is used where the tests need a NON-ZERO accept rate: an
+untrained learned-position model emits an aperiodic stream (~every
+token unique), while rope dynamics are position-relative and fall into
+the recurrent regime n-gram self-drafting targets.  Token identity is
+asserted on BOTH geometries either way — acceptance only changes how
+much work the verify path saves, never which tokens come out.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.models import bert, gpt
+from mpi_tensorflow_tpu.serving import (BlockAllocator, Drafter,
+                                        NgramDrafter, PagedDecodeEngine,
+                                        ReplayJournal, Request, Scheduler,
+                                        ServeConfig, run_with_replay)
+
+TINY = dataclasses.replace(bert.BERT_TINY, ce_positions="all")
+ROPE = dataclasses.replace(TINY, pos_kind="rope")
+
+
+def _generate_ref(model, params, prompt, n):
+    import jax.numpy as jnp
+
+    out = np.asarray(model.generate(
+        params, jnp.asarray([prompt], jnp.int32), n))
+    return list(map(int, out[0, len(prompt):]))
+
+
+def _shared_trace(rng, n=5, prefix=8, tail_hi=5, budget=24, vocab=None,
+                  tail_lens=None):
+    vocab = vocab or TINY.vocab_size
+    shared = list(map(int, rng.integers(0, vocab, prefix)))
+    if tail_lens is None:
+        tail_lens = rng.integers(1, tail_hi + 1, n)
+    prompts = [shared + list(map(int, rng.integers(0, vocab, int(s))))
+               for s in tail_lens]
+    return [Request(i, p, budget, arrival=0.0)
+            for i, p in enumerate(prompts)]
+
+
+SERVE = ServeConfig(num_blocks=96, block_size=4, max_slots=3,
+                    max_seq_len=64, prefill_chunk=8)
+
+
+def _pair(cfg, *, key=0, **spec_kw):
+    """(model, params, off-engine, speculative-engine) on one config."""
+    import jax
+
+    model = gpt.CausalLm(cfg)
+    params = model.init(jax.random.key(key))
+    serve_kw = {k: v for k, v in spec_kw.items()
+                if k not in ("draft_model", "draft_params")}
+    eng_kw = {k: v for k, v in spec_kw.items()
+              if k in ("draft_model", "draft_params")}
+    off = PagedDecodeEngine(model, params, SERVE)
+    spec = PagedDecodeEngine(
+        model, params, dataclasses.replace(SERVE, **serve_kw), **eng_kw)
+    return model, params, off, spec
+
+
+# ------------------------------------------------------------- drafters
+
+@pytest.mark.quick
+class TestNgramDrafter:
+    def test_novel_context_returns_no_draft(self):
+        d = NgramDrafter()
+        assert d.draft(0, [1, 2, 3, 4, 5], 4) == []
+
+    def test_suffix_match_proposes_the_continuation(self):
+        d = NgramDrafter()
+        # suffix [1, 2] occurred earlier followed by [9, 7]
+        assert d.draft(0, [5, 1, 2, 9, 7, 1, 2], 2) == [9, 7]
+
+    def test_longer_ngram_wins_over_shorter(self):
+        d = NgramDrafter()
+        # the 2-gram [3, 4] picks the [3, 4] -> 8 continuation even
+        # though the most recent 1-gram match ([4] at index 5) differs
+        ctx = [3, 4, 8, 6, 3, 4, 9, 3, 4]
+        assert d.draft(0, ctx, 1) == [9]
+
+    def test_full_window_preferred_over_recent_partial(self):
+        d = NgramDrafter(max_ngram=2)
+        # suffix [1, 1]: the most recent match (idx 5) runs into the
+        # end of ctx with only one following token; the match at idx 0
+        # carries a full k window and wins
+        ctx = [1, 1, 2, 3, 4, 1, 1, 1]
+        assert d.draft(0, ctx, 3) == [2, 3, 4]
+
+    def test_partial_window_returned_when_nothing_full(self):
+        d = NgramDrafter(max_ngram=2)
+        assert d.draft(0, [1, 2, 9, 1, 2], 4) == [9, 1, 2]
+
+    def test_degenerate_inputs(self):
+        d = NgramDrafter()
+        assert d.draft(0, [7], 4) == []
+        assert d.draft(0, [1, 2, 1, 2], 0) == []
+        with pytest.raises(ValueError, match="min_ngram"):
+            NgramDrafter(max_ngram=0)
+
+
+# --------------------------------------------- scheduler generalization
+
+@pytest.mark.quick
+class TestSchedulerMultiToken:
+    def _live(self, blocks=16, slots=2, bs=4, nb=4, prompt=3, budget=8):
+        s = Scheduler(BlockAllocator(blocks), slots, bs, nb)
+        s.submit(Request(0, [1] * prompt, budget))
+        (slot,) = s.admit()
+        s.slots[slot].prefilled = prompt
+        return s, slot
+
+    def test_record_tokens_appends_all_within_budget(self):
+        s, slot = self._live(budget=8)
+        assert s.record_tokens(slot, [7, 8, 9]) == 3
+        assert s.slots[slot].generated == [7, 8, 9]
+
+    def test_record_tokens_stops_at_budget(self):
+        s, slot = self._live(budget=2)
+        assert s.record_tokens(slot, [7, 8, 9, 10]) == 2
+        assert s.slots[slot] is None
+        assert s.finished[0].generated == [7, 8]
+        assert s.allocator.num_used == 0
+
+    def test_record_tokens_stops_at_eos(self):
+        s, slot = self._live(budget=8)
+        assert s.record_tokens(slot, [7, 99, 8], eos_id=99) == 2
+        assert s.slots[slot] is None
+        assert s.finished[0].generated == [7, 99]
+
+    def test_extend_for_takes_only_free_blocks_no_eviction(self):
+        s, slot = self._live(blocks=16, bs=4, nb=8, prompt=3)
+        base = len(s.slots[slot].block_ids)
+        # plenty free: full draft window granted
+        assert s.extend_for(slot, 4 + 8) == (base + 2) * 4
+        # drain the pool, then park a second sequence: extend_for must
+        # neither evict it nor grow past what is free
+        s.submit(Request(1, [1] * 3, 4, arrival=1.0))
+        (other,) = s.admit()
+        s.slots[other].prefilled = 3
+        held = s.allocator.alloc(s.allocator.num_free)
+        covered = s.extend_for(slot, 64)
+        assert covered == (base + 2) * 4          # unchanged: no free
+        assert s.slots[other] is not None, "extend_for must not preempt"
+        s.allocator.free(held)
+        s.allocator.check()
+
+    def test_extend_for_caps_at_max_blocks_per_seq(self):
+        s, slot = self._live(blocks=32, bs=4, nb=4, prompt=3)
+        assert s.extend_for(slot, 10 ** 6) == 4 * 4
+
+    def test_rollback_releases_trailing_blocks(self):
+        """THE rollback unit pin: blocks allocated for a draft window
+        whose tokens were rejected return to the pool, and the
+        allocator's partition invariant still holds."""
+        s, slot = self._live(blocks=16, bs=4, nb=8, prompt=3)
+        seq = s.slots[slot]
+        s.extend_for(slot, 4 + 12)                # window for 12 drafts
+        assert len(seq.block_ids) == 4
+        used = s.allocator.num_used
+        assert s.rollback_blocks(slot, 5) == 2    # keep 2 blocks (5 toks)
+        assert s.allocator.num_used == used - 2
+        assert len(seq.block_ids) == 2
+        s.allocator.check()
+        assert s.rollback_blocks(slot, 5) == 0    # idempotent
+
+    def test_rollback_never_touches_needed_blocks(self):
+        s, slot = self._live(bs=4, prompt=3)
+        assert s.rollback_blocks(slot, 4) == 0
+        assert s.ensure_block(slot)
+
+
+# ------------------------------------------------------ token identity
+
+class TestSpeculativeParity:
+    def test_ngram_token_identical_on_aperiodic_stream(self):
+        """Learned positions: the untrained stream never repeats, so
+        the drafter proposes little and accepts nothing — outputs must
+        STILL be exactly off-mode's (the no-draft degenerate case is a
+        plain decode step)."""
+        model, params, off, spec = _pair(TINY, speculative="ngram",
+                                         draft_k=4)
+        rng = np.random.default_rng(0)
+        reqs = _shared_trace(rng, n=5, budget=8)
+        want = off.run([dataclasses.replace(r) for r in reqs])
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        assert got["outputs"] == want["outputs"]
+        assert got["speculation"]["enabled"]
+        assert got["speculation"]["verify_forwards"] > 0
+
+    def test_ngram_accepts_on_recurrent_stream_and_stays_identical(self):
+        """ROPE geometry: the stream is recurrent, the self-draft lands
+        — accept_rate > 0, steps_saved > 0 (fewer verify forwards than
+        emitted tokens), outputs still exactly off-mode's and
+        generate()'s.  The CPU-measurable form of the ISSUE's
+        bandwidth-proxy acceptance criterion."""
+        model, params, off, spec = _pair(ROPE, speculative="ngram",
+                                         draft_k=4)
+        rng = np.random.default_rng(1)
+        reqs = _shared_trace(rng, n=4, budget=32)
+        want = off.run([dataclasses.replace(r) for r in reqs])
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        assert got["outputs"] == want["outputs"]
+        sp = got["speculation"]
+        assert sp["accepted_tokens"] > 0 and sp["accept_rate"] > 0
+        assert sp["steps_saved"] > 0
+        assert sp["verify_forwards"] < sp["emitted_tokens"]
+        for r in reqs:
+            assert got["outputs"][r.id] == _generate_ref(
+                model, params, r.prompt, r.max_new_tokens)
+
+    def test_draft_model_token_identical_with_fresh_drafter(self):
+        """The default (untrained, fresh-init) tiny drafter disagrees
+        with the target almost everywhere — every draft dies at verify,
+        outputs must not move."""
+        model, params, off, spec = _pair(TINY, speculative="draft-model",
+                                         draft_k=3)
+        rng = np.random.default_rng(2)
+        reqs = _shared_trace(rng, n=4, budget=8)
+        want = off.run([dataclasses.replace(r) for r in reqs])
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        assert got["outputs"] == want["outputs"]
+        assert got["speculation"]["draft_tokens"] > 0
+        spec.drafter.check_quiescent()
+
+    def test_draft_model_self_draft_accepts_fully(self):
+        """Drafter == target (injected): every draft token survives
+        verification — accept_rate 1.0, the all-accept boundary of the
+        acceptance rule — and outputs still match generate()."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, speculative="draft-model",
+                                    draft_k=4)
+        spec = PagedDecodeEngine(model, params, serve,
+                                 draft_model=model, draft_params=params)
+        rng = np.random.default_rng(3)
+        reqs = _shared_trace(rng, n=4, budget=12)
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        sp = got["speculation"]
+        assert sp["accept_rate"] == 1.0
+        assert sp["steps_saved"] > 0
+        for r in reqs:
+            assert got["outputs"][r.id] == _generate_ref(
+                model, params, r.prompt, r.max_new_tokens)
+
+    def test_eos_inside_accepted_window_truncates_stream(self):
+        """EOS emitted mid-window must end the stream exactly where
+        one-token decode would — nothing past EOS streams or lands in
+        the journal."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        probe = PagedDecodeEngine(model, params, SERVE)
+        full = probe.run([Request(0, [5, 6, 7], 8)])["outputs"][0]
+        eos = full[3]
+        serve = dataclasses.replace(SERVE, speculative="draft-model",
+                                    draft_k=4, eos_id=eos)
+        spec = PagedDecodeEngine(model, params, serve,
+                                 draft_model=model, draft_params=params)
+        res = spec.run([Request(0, [5, 6, 7], 8)])
+        assert res["outputs"][0] == full[:full.index(eos) + 1]
+        spec.sched.check_quiescent()
+
+
+# ----------------------------------------- prefix cache / CoW / stress
+
+class TestSpeculativeWithPrefixCache:
+    def test_shared_prefix_cache_on_token_identical_with_hits(self):
+        """Prefix cache AND speculation on together: trie hits land,
+        drafts verify, outputs equal the everything-off engine's."""
+        model, params, off, spec = _pair(
+            ROPE, speculative="ngram", draft_k=4, prefix_cache="on")
+        rng = np.random.default_rng(4)
+        reqs = _shared_trace(rng, n=5, prefix=12, budget=24)
+        want = off.run([dataclasses.replace(r) for r in reqs])
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        assert got["outputs"] == want["outputs"]
+        assert got["prefix"]["hit_tokens"] > 0
+        assert got["speculation"]["accepted_tokens"] > 0
+
+    def test_cow_on_shared_block_inside_draft_window(self):
+        """Identical exact-block-multiple prompts, one slot, drafter ==
+        target: the verify window's FIRST write (the shared-final-block
+        recompute) plus its accepted draft writes span a shared block —
+        the CoW guard must privatize the whole range before the
+        dispatch, and the donor's cached content must survive."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, max_slots=1,
+                                    prefix_cache="on",
+                                    speculative="draft-model", draft_k=4)
+        spec = PagedDecodeEngine(model, params, serve,
+                                 draft_model=model, draft_params=params)
+        rng = np.random.default_rng(21)
+        prompt = list(map(int, rng.integers(0, TINY.vocab_size, 8)))
+        assert len(prompt) % serve.block_size == 0
+        budgets = [6, 4, 2]
+        res = spec.run([Request(i, list(prompt), n, arrival=0.0)
+                        for i, n in enumerate(budgets)])
+        assert res["prefix"]["cow_copies"] >= 1, \
+            "the shared-final-block recompute must trigger CoW"
+        assert res["speculation"]["accepted_tokens"] > 0, \
+            "the draft window was meant to be live through the CoW"
+        want = _generate_ref(model, params, prompt, max(budgets))
+        for i, n in enumerate(budgets):
+            assert res["outputs"][i] == want[:n], \
+                f"request {i} diverged after CoW inside a draft window"
+
+    def test_eviction_mid_draft_restarts_exact(self):
+        """A tight pool preempts a sequence while speculation is live:
+        restart-from-scratch replay (and the drafter's stale per-request
+        state) must not perturb a single token."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = ServeConfig(num_blocks=9, block_size=2, max_slots=2,
+                            max_seq_len=12, prefill_chunk=2,
+                            speculative="draft-model", draft_k=3)
+        engine = PagedDecodeEngine(model, params, serve,
+                                   draft_model=model, draft_params=params)
+        rng = np.random.default_rng(8)
+        pa = list(map(int, rng.integers(0, TINY.vocab_size, 2)))
+        pb = list(map(int, rng.integers(0, TINY.vocab_size, 11)))
+        res = engine.run([Request(0, pa, 10, arrival=0.0),
+                          Request(1, pb, 1, arrival=0.0)])
+        assert engine.sched.evictions >= 1, \
+            "trace was meant to exercise eviction"
+        assert res["outputs"][0] == _generate_ref(model, params, pa, 10)
+        assert res["outputs"][1] == _generate_ref(model, params, pb, 1)
+        engine.allocator.check()
+        engine.drafter.check_quiescent()
+
+    def test_deadline_expiry_mid_draft_is_terminal_not_fatal(self):
+        """A deadline sweep that kills a sequence between draft windows
+        frees its engine blocks AND its drafter state; survivors keep
+        their exact streams."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, speculative="draft-model",
+                                    draft_k=3)
+        engine = PagedDecodeEngine(model, params, serve,
+                                   draft_model=model, draft_params=params)
+        clock = {"t": 0.0}
+
+        def fake_time():
+            clock["t"] += 0.01
+            return clock["t"]
+
+        res = engine.run(
+            [Request(0, [1, 2, 3], 20, arrival=0.0, deadline=0.05),
+             Request(1, [4, 5], 3, arrival=0.0)], time_fn=fake_time)
+        assert res["statuses"][0] == "deadline_exceeded"
+        assert res["statuses"][1] == "ok"
+        assert res["outputs"][1] == _generate_ref(model, params, [4, 5], 3)
+        assert engine.allocator.num_used == 0
+        engine.drafter.check_quiescent()
+
+
+# -------------------------------------------------------------- rollback
+
+class _WrongDrafter(Drafter):
+    """Adversarial drafter: proposes, at every position, the true next
+    token PLUS ONE (mod vocab) — guaranteed to mismatch the target's
+    argmax chain at lane 0, so every verify step allocates a full draft
+    window and must roll all of it back."""
+
+    def __init__(self, truth, prompts, vocab):
+        self.truth = truth        # rid -> full true output stream
+        self.prompts = prompts    # rid -> prompt (to locate ctx in it)
+        self.vocab = vocab
+        self.calls = 0
+
+    def draft(self, rid, ctx, k):
+        self.calls += 1
+        # ctx = prompt + generated; the next emitted tokens would be
+        # truth[len(generated):] — corrupt exactly those
+        g = len(ctx) - len(self.prompts[rid])
+        return [(t + 1) % self.vocab
+                for t in self.truth[rid][g:g + k]]
+
+
+class TestRollback:
+    def test_rejected_draft_blocks_released_and_quiescent(self):
+        """THE rollback pin: with an always-wrong drafter, every verify
+        window's trailing blocks are phantom storage — after each step
+        they must be back in the pool (live blocks never exceed the
+        off-mode requirement) and check_quiescent() holds at the end."""
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        rng = np.random.default_rng(9)
+        prompts = [list(map(int, rng.integers(0, TINY.vocab_size, 5)))
+                   for _ in range(3)]
+        budget = 10
+        truth = {i: _generate_ref(model, params, p, budget)
+                 for i, p in enumerate(prompts)}
+
+        serve = dataclasses.replace(SERVE, speculative="ngram", draft_k=4)
+        engine = PagedDecodeEngine(model, params, serve)
+        engine.drafter = _WrongDrafter(truth, dict(enumerate(prompts)),
+                                       TINY.vocab_size)
+        reqs = [Request(i, p, budget, arrival=0.0)
+                for i, p in enumerate(prompts)]
+        res = engine.run(reqs)
+        assert engine.drafter.calls > 0
+        sp = res["speculation"]
+        assert sp["draft_tokens"] > 0 and sp["accepted_tokens"] == 0
+        assert sp["steps_saved"] == 0
+        for i, p in enumerate(prompts):
+            assert res["outputs"][i] == truth[i], \
+                "an all-rejected draft changed emitted tokens"
+        # every draft-window block was rolled back: nothing leaked
+        engine.sched.check_quiescent()
+        assert engine.allocator.num_used == 0
+
+    def test_rollback_frees_blocks_step_by_step(self):
+        """Track the pool between steps: after a verify step with zero
+        acceptance, the sequence holds exactly the blocks off-mode
+        decode would (no phantom tail)."""
+        import jax
+
+        from mpi_tensorflow_tpu.serving.paged_cache import blocks_for
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        prompt = [3, 1, 4, 1, 5]
+        truth = {0: _generate_ref(model, params, prompt, 8)}
+        serve = dataclasses.replace(SERVE, speculative="ngram", draft_k=4)
+        engine = PagedDecodeEngine(model, params, serve)
+        engine.drafter = _WrongDrafter(truth, {0: prompt},
+                                       TINY.vocab_size)
+        engine.sched.submit(Request(0, prompt, 8, arrival=0.0))
+        while not engine.sched.all_done():
+            engine.step()
+            for seq in engine.sched.slots:
+                if seq is None or seq.prefilled < len(prompt):
+                    continue
+                assert len(seq.block_ids) <= blocks_for(
+                    seq.length + 1, serve.block_size), \
+                    "phantom draft blocks survived the step"
+        assert engine.allocator.num_used == 0
+
+
+# ---------------------------------------------------- replay / recovery
+
+class TestSpeculativeReplay:
+    def _flaky_verify_factory(self, model, params, serve, fail_on_call=3,
+                              times=1, **eng_kw):
+        state = {"faults_left": times}
+
+        def make_engine():
+            engine = PagedDecodeEngine(model, params, serve, **eng_kw)
+            if state["faults_left"] > 0:
+                state["faults_left"] -= 1
+                orig, calls = engine._verify_fn, {"n": 0}
+
+                def flaky(*a, **k):
+                    calls["n"] += 1
+                    if calls["n"] == fail_on_call:
+                        raise RuntimeError(
+                            "UNAVAILABLE: simulated device loss")
+                    return orig(*a, **k)
+
+                engine._verify_fn = flaky
+            return engine
+
+        return make_engine
+
+    def test_transient_fault_replay_token_identical(self):
+        """Mid-verify device loss -> engine (and draft pool) rebuilt ->
+        replay: merged outputs equal an unfaulted OFF-mode run's, and
+        the merged speculation block spans both attempts."""
+        import jax
+
+        model = gpt.CausalLm(ROPE)
+        params = model.init(jax.random.key(1))
+        rng = np.random.default_rng(11)
+        reqs = _shared_trace(rng, n=4, budget=20)
+        want = PagedDecodeEngine(model, params, SERVE).run(
+            [dataclasses.replace(r) for r in reqs])
+        serve = dataclasses.replace(SERVE, speculative="ngram", draft_k=4)
+        res = run_with_replay(
+            self._flaky_verify_factory(model, params, serve),
+            [dataclasses.replace(r) for r in reqs])
+        assert res["replays"] == 1
+        assert res["outputs"] == want["outputs"]
+        assert res["speculation"]["enabled"]
+        assert res["speculation"]["verify_forwards"] > 0
+
+    def test_sigkill_journal_holds_accepted_tokens_only(self, tmp_path):
+        """Simulated SIGKILL mid-run: the journal on disk must contain,
+        for every live request, a strict PREFIX of the true greedy
+        stream — accepted tokens only, never a rejected draft — and a
+        cold resume completes token-identically."""
+        import jax
+
+        model = gpt.CausalLm(ROPE)
+        params = model.init(jax.random.key(1))
+        rng = np.random.default_rng(12)
+        reqs = _shared_trace(rng, n=4, budget=20)
+        want = PagedDecodeEngine(model, params, SERVE).run(
+            [dataclasses.replace(r) for r in reqs])
+        path = str(tmp_path / "journal.jsonl")
+        serve = dataclasses.replace(SERVE, speculative="ngram", draft_k=4)
+
+        factory = self._flaky_verify_factory(model, params, serve,
+                                             fail_on_call=4)
+        with pytest.raises(RuntimeError):
+            factory().run([dataclasses.replace(r) for r in reqs],
+                          journal=ReplayJournal(path))
+
+        mid = ReplayJournal(path)
+        assert any(ent.toks for ent in mid.entries.values()), \
+            "the crash was meant to land mid-stream"
+        for rid, ent in mid.entries.items():
+            n = len(ent.toks)
+            assert ent.toks == want["outputs"][rid][:n], (
+                f"request {rid}: journal holds non-accepted tokens "
+                f"{ent.toks} vs true stream {want['outputs'][rid]}")
+        mid.close()
+
+        res = run_with_replay(
+            lambda: PagedDecodeEngine(model, params, serve),
+            [dataclasses.replace(r) for r in reqs], journal_path=path)
+        assert res["outputs"] == want["outputs"]
+        assert all(s == "ok" for s in res["statuses"].values())
+
+
+# ------------------------------------------------- recompile discipline
+
+class TestSpeculativeCompileDiscipline:
+    def test_zero_recompiles_steady_state_ngram(self):
+        """THE zero-recompile acceptance pin for speculative mode: the
+        verify pre-warm covers every bucket at build, so a fresh trace
+        with DIFFERENT content (hence different acceptance patterns,
+        hence different bucket visits) adds no compiles."""
+        import jax
+
+        model = gpt.CausalLm(ROPE)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, speculative="ngram", draft_k=4)
+        engine = PagedDecodeEngine(model, params, serve)
+        warm0 = engine.compile_counts()
+        assert warm0["verify"] > 0, "verify pre-warm did not compile"
+
+        def trace(seed):
+            # fixed tail LENGTHS across seeds: prefill bucket visits
+            # depend on the trace envelope for off-mode and speculative
+            # alike — only CONTENT (and hence acceptance, the thing the
+            # verify pre-warm must cover) varies here
+            r = np.random.default_rng(seed)
+            return _shared_trace(r, n=5, budget=24,
+                                 tail_lens=[1, 2, 3, 4, 5])
+
+        engine.run(trace(0))
+        warm = engine.compile_counts()
+        engine.reset()
+        engine.run(trace(13))                # new content, same envelope
+        assert engine.compile_counts() == warm, \
+            "speculative steady state recompiled"
+
+    def test_zero_recompiles_steady_state_draft_model(self):
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, speculative="draft-model",
+                                    draft_k=3)
+        engine = PagedDecodeEngine(model, params, serve,
+                                   draft_model=model, draft_params=params)
+        assert engine.compile_counts()["draft"] > 0, \
+            "drafter chunk-bucket pre-warm did not compile"
+
+        def trace(seed):
+            # fixed tail lengths: content-only variation (see ngram pin)
+            r = np.random.default_rng(seed)
+            return _shared_trace(r, n=4, budget=10,
+                                 tail_lens=[1, 2, 3, 4])
+
+        engine.run(trace(0))
+        warm = engine.compile_counts()
+        engine.reset()
+        engine.run(trace(5))
+        assert engine.compile_counts() == warm, \
+            "draft-model steady state recompiled"
+
+    def test_verify_dispatch_shapes_are_bucketed(self):
+        import jax
+
+        model = gpt.CausalLm(ROPE)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, speculative="ngram", draft_k=4)
+        engine = PagedDecodeEngine(model, params, serve)
+        rng = np.random.default_rng(14)
+        engine.run(_shared_trace(rng, n=5, budget=12))
+        kinds = {s[0] for s in engine.dispatch_shapes}
+        assert "verify" in kinds and "decode" not in kinds, \
+            "speculative mode must route all decode work through verify"
+        caps = (serve.max_slots, serve.max_blocks_per_seq)
+        for shape in engine.dispatch_shapes:
+            for dim, cap in zip(shape[1:], caps):
+                # pow2, or clamped at the configured cap (engine._bucket
+                # rounds up then caps — same discipline as decode)
+                assert dim & (dim - 1) == 0 or dim == cap, \
+                    f"unbucketed dispatch {shape}"
+
+
+# ------------------------------------------------------------ cli guards
+
+@pytest.mark.quick
+class TestSpeculativeCliGuards:
+    def test_knobs_bridge_cli_config_serveconfig(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(
+            ["--serve-speculative", "ngram", "--serve-draft-k", "6"])
+        c = cli.config_from_args(args)
+        assert (c.serve_speculative, c.serve_draft_k) == ("ngram", 6)
+        s = ServeConfig.from_config(c)
+        assert (s.speculative, s.draft_k) == ("ngram", 6)
+        # defaults: off, byte-for-byte today's one-token loop
+        s0 = ServeConfig.from_config(cli.config_from_args(
+            cli.build_parser().parse_args([])))
+        assert s0.speculative == "off" and s0.draft_k == 4
+
+    def test_bad_values_rejected_at_every_layer(self):
+        from mpi_tensorflow_tpu import cli
+        from mpi_tensorflow_tpu.config import Config
+
+        with pytest.raises(SystemExit):
+            cli.main(["--serve-speculative", "maybe"])     # argparse
+        with pytest.raises(SystemExit, match="draft-k"):
+            cli.main(["--serve-draft-k", "0"])             # cli.main
+        with pytest.raises(ValueError, match="speculative"):
+            ServeConfig(speculative="auto")
+        with pytest.raises(ValueError, match="draft_k"):
+            ServeConfig(draft_k=0)
+        # programmatic Config path dies at cli.main's own guard
+        with pytest.raises(ValueError, match="speculative"):
+            ServeConfig.from_config(Config(serve_speculative="maybe"))
+
+    def test_make_drafter_rejects_unknown_mode(self):
+        from mpi_tensorflow_tpu.serving import make_drafter
+
+        assert make_drafter("off", SERVE, None) is None
+        with pytest.raises(ValueError, match="speculative"):
+            make_drafter("turbo", SERVE, None)
