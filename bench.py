@@ -414,7 +414,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     prefix_tokens: int = 0,
                     speculative: str | None = None,
                     draft_k: int | None = None,
-                    spec_ab: bool = False) -> dict:
+                    spec_ab: bool = False,
+                    draft_auto: str | None = None,
+                    tp: int | None = None,
+                    replicas: int | None = None) -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic Poisson request trace.
 
@@ -474,7 +477,23 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     ``spec_ab`` additionally TIMES that off arm (own warmup, own
     zero-recompile probe) and emits the wall-clock ``spec_speedup``
     line — mirroring ``kernel_ab``, and mutually exclusive with it
-    (one comparison, one variable).
+    (one comparison, one variable).  ``draft_auto`` turns on EWMA
+    draft-window auto-tuning (--serve-draft-auto; the ``speculation``
+    block reports the resulting ``effective_k``).
+
+    Distributed serving: ``tp`` shards the timed engine tensor-parallel
+    over the first ``tp`` visible devices (serving/tp — the dispatch
+    discipline, zero-recompile probes, and every control arm work
+    unchanged on the sharded engine).  ``replicas > 1`` ADDS a
+    data-parallel arm after the timed single-engine run: the same trace
+    through ``replicas`` engine replicas behind the serving router
+    (session-affinity + least-load placement; one thread per replica on
+    multi-core hosts so device work overlaps, sequential round-robin on
+    one core — ``router.default_parallelism``), emitting per-replica
+    metrics (queue depth, pool occupancy, shed rate, tokens/sec) and
+    the aggregate-vs-single speedup — the scale-out acceptance signal,
+    whose >1 reading needs the threaded mode and real parallel cores
+    (the detail's ``replicas.parallel`` flag says which mode ran).
     """
     import dataclasses as dc
     import time
@@ -552,9 +571,22 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         cfg, num_blocks=pool_blocks, block_size=block_size,
         max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
         prefix_cache=prefix_cache, speculative=speculative,
-        draft_k=draft_k,
+        draft_k=draft_k, draft_auto=draft_auto, tp=tp,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
+    replicas = 1 if replicas is None else replicas
+    if replicas < 1:
+        raise ValueError(f"--serve-replicas must be >= 1, got {replicas}")
+    if replicas > 1 and journal is not None:
+        raise ValueError("--serve-replicas adds a routed multi-engine "
+                         "arm; the journaled serve mode is a single "
+                         "supervised engine — pick one")
+    if replicas > 1 and (kernel_ab or spec_ab):
+        raise ValueError("--serve-replicas adds its own comparison arm "
+                         "(aggregate vs single engine); combining it "
+                         "with --serve-kernel-ab/--serve-spec-ab would "
+                         "change two variables in one comparison — "
+                         "pick one")
     if kernel_ab and journal is not None:
         raise ValueError("--serve-kernel-ab is a measurement (two timed "
                          "arms); the journaled serve mode is not — pick "
@@ -639,6 +671,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "speculation": res.get("speculation"),
             "serve_speculative": serve.speculative,
             "serve_draft_k": serve.draft_k,
+            "serve_draft_auto": serve.draft_auto,
+            "serve_tp": serve.tp,
+            "serve_replicas": 1,
             "peak_blocks_in_use": res.get("peak_blocks_in_use"),
             "peak_live_blocks": res.get("peak_live_blocks"),
             "serving_tokens_per_sec": res["tokens_per_sec"],
@@ -785,6 +820,45 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                            {**w_off, **s_off}.values()) else None),
             }
 
+    replicas_detail = None
+    if replicas > 1:
+        # the data-parallel scale-out arm: the SAME trace through N
+        # engine replicas behind the serving router, each replica
+        # stepped from its own thread (jax dispatch/blocking release
+        # the GIL, so replica device work overlaps — the in-process
+        # stand-in for one-process-per-chip).  Warmed untimed first
+        # (each replica pays its own bucket compiles), then timed —
+        # exactly the single-engine arm's discipline, so the
+        # aggregate-vs-single comparison is steady state on both sides.
+        from mpi_tensorflow_tpu.serving.router import ReplicaRouter
+
+        router = ReplicaRouter([PagedDecodeEngine(model, params, serve)
+                                for _ in range(replicas)])
+        router.run(trace())
+        router.reset()
+        rr = router.run(trace())
+        replicas_detail = {
+            "n": replicas,
+            # threads on multi-core hosts (replica device work
+            # overlaps); sequential round-robin on a single core,
+            # where the threaded ping-pong is pure GIL overhead and
+            # the >1 aggregate speedup physically needs parallel
+            # hardware (router.default_parallelism)
+            "parallel": rr["parallel"],
+            "per_replica": rr["replicas"],
+            "aggregate_tokens_per_sec": rr["tokens_per_sec"],
+            # >1 = the routed fleet beats one engine on the same trace
+            # (THE scale-out acceptance number)
+            "speedup_vs_single_replica": (
+                round(rr["tokens_per_sec"] / cb["tokens_per_sec"], 3)
+                if cb["tokens_per_sec"] > 0 else None),
+            "token_identical_vs_single": rr["outputs"] == cb["outputs"],
+            "sticky_sessions": rr["sticky_sessions"],
+            "p50_token_latency_ms": rr["p50_token_latency_ms"],
+            "p99_token_latency_ms": rr["p99_token_latency_ms"],
+            "status_counts": dict(Counter(rr["statuses"].values())),
+        }
+
     # -- static-batch baseline: generate() on arrival-order groups of
     # max_slots, each padded to its longest prompt and decoded to its
     # longest output budget, one shared cache capacity per batch --
@@ -831,6 +905,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "spec_ab": spec_ab_detail,
         "serve_speculative": serve.speculative,
         "serve_draft_k": serve.draft_k,
+        "serve_draft_auto": serve.draft_auto,
+        "serve_tp": serve.tp,
+        "serve_replicas": replicas,
+        "replicas": replicas_detail,
         "peak_blocks_in_use": cb["peak_blocks_in_use"],
         "peak_live_blocks": cb["peak_live_blocks"],
         "serving_tokens_per_sec": cb["tokens_per_sec"],
@@ -1185,6 +1263,21 @@ def _stale_score(args, d: dict, item=None):
                 (getattr(args, "serve_draft_k", None)
                  or serve_defaults.serve_draft_k):
             return None
+        if want_spec != "off" and d.get("serve_draft_auto", "off") != \
+                (getattr(args, "serve_draft_auto", None)
+                 or serve_defaults.serve_draft_auto):
+            return None      # the tuned window changes the step structure
+        # distributed serving shapes the timed arm (tp shards it) and
+        # the comparison set (replicas adds a routed arm) — a record
+        # under a different layout is a different number (absent keys
+        # on old records read as the pre-distributed defaults: 1 / 1)
+        if d.get("serve_tp", 1) != (getattr(args, "serve_tp", None)
+                                    or serve_defaults.serve_tp):
+            return None
+        if d.get("serve_replicas", 1) != \
+                (getattr(args, "serve_replicas", None)
+                 or serve_defaults.serve_replicas):
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1336,6 +1429,11 @@ def _report(args, d: dict, stale: bool = False) -> int:
         if sab is not None:
             # THE wall-clock line the spec A/B flag exists for
             out["spec_speedup"] = sab.get("spec_speedup_vs_off")
+        reps = d.get("replicas")
+        if reps is not None:
+            # THE scale-out line the replica flag exists for: the routed
+            # fleet's aggregate rate over the single engine's
+            out["replica_speedup"] = reps.get("speedup_vs_single_replica")
         _print_json(out)
         return 0
     if args.mode == "decode":
@@ -1548,6 +1646,28 @@ def main(argv=None) -> int:
                     help="serving mode: speculative draft window — "
                          "tokens proposed per verify forward; >= 1 "
                          "(default: the run Config's serve_draft_k)")
+    ap.add_argument("--serve-draft-auto", choices=["off", "on"],
+                    default=None,
+                    help="serving: auto-tune the speculative draft "
+                         "window from the observed accept rate (EWMA, "
+                         "clamped to [1, --serve-draft-k]; the "
+                         "speculation block reports effective_k). "
+                         "Default: the run Config's serve_draft_auto")
+    ap.add_argument("--serve-tp", type=int, default=None,
+                    help="serving: tensor-parallel shards for the "
+                         "decode engine — shard the paged pool's head "
+                         "axis, QKV/O, and MLP over a tp mesh axis "
+                         "(serving/tp); must divide the model's heads/"
+                         "mlp and fit the visible device count "
+                         "(default: the run Config's serve_tp)")
+    ap.add_argument("--serve-replicas", type=int, default=None,
+                    help="serving: run an additional data-parallel arm "
+                         "— the same trace through N engine replicas "
+                         "behind the serving router (session affinity "
+                         "+ least-load placement, one thread per "
+                         "replica), reporting per-replica queue depth/"
+                         "occupancy/shed rate/tokens-per-sec and the "
+                         "aggregate-vs-single speedup")
     ap.add_argument("--serve-spec-ab", action="store_true",
                     help="serving mode: TIME the speculation-off "
                          "control arm too (own warmup, own zero-"
@@ -1658,6 +1778,28 @@ def main(argv=None) -> int:
         ap.error("--serve-spec-ab and --serve-kernel-ab each replay the "
                  "trace through their own control arm; one comparison, "
                  "one variable — pick one")
+    if (args.serve_tp is not None or args.serve_replicas is not None
+            or args.serve_draft_auto is not None) \
+            and args.mode != "serving":
+        ap.error("--serve-tp/--serve-replicas/--serve-draft-auto shape "
+                 "the serving trace; other modes would silently ignore "
+                 "them")
+    if args.serve_tp is not None and args.serve_tp < 1:
+        ap.error(f"--serve-tp must be >= 1, got {args.serve_tp}")
+    if args.serve_replicas is not None and args.serve_replicas < 1:
+        ap.error(f"--serve-replicas must be >= 1, got "
+                 f"{args.serve_replicas}")
+    if args.serve_replicas is not None and args.serve_replicas > 1 \
+            and (args.serve_kernel_ab or args.serve_spec_ab
+                 or args.serve_journal is not None):
+        ap.error("--serve-replicas adds its own routed arm (aggregate "
+                 "vs single engine); combine with --serve-kernel-ab/"
+                 "--serve-spec-ab/--serve-journal one at a time")
+    if args.serve_draft_auto == "on" \
+            and args.serve_speculative in (None, "off"):
+        ap.error("--serve-draft-auto on tunes the speculative draft "
+                 "window; pick a drafter with --serve-speculative "
+                 "ngram|draft-model")
     if args.serve_spec_ab and args.serve_speculative in (None, "off"):
         ap.error("--serve-spec-ab compares speculative decoding against "
                  "its off arm; pick a drafter with --serve-speculative "
@@ -1742,7 +1884,10 @@ def main(argv=None) -> int:
                             prefix_tokens=args.serve_prefix_tokens,
                             speculative=args.serve_speculative,
                             draft_k=args.serve_draft_k,
-                            spec_ab=args.serve_spec_ab)
+                            spec_ab=args.serve_spec_ab,
+                            draft_auto=args.serve_draft_auto,
+                            tp=args.serve_tp,
+                            replicas=args.serve_replicas)
         return _report(args, r)
 
     if args.mode == "decode":

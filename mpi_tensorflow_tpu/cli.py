@@ -171,6 +171,29 @@ def build_parser() -> argparse.ArgumentParser:
                    help="serving: speculative draft window — tokens "
                         "proposed per verify forward (dispatch width "
                         "draft_k + 1); >= 1")
+    p.add_argument("--serve-draft-auto", choices=["off", "on"],
+                   default=d.serve_draft_auto,
+                   help="serving: auto-tune the speculative draft "
+                        "window — on adapts the effective k to an EWMA "
+                        "of the observed accept length, clamped to "
+                        "[1, --serve-draft-k] (the verify dispatch "
+                        "width never changes, so the zero-recompile "
+                        "contract is untouched); needs a drafter "
+                        "(--serve-speculative ngram|draft-model)")
+    p.add_argument("--serve-tp", type=int, default=d.serve_tp,
+                   help="serving: tensor-parallel shards for the decode "
+                        "engine — >1 partitions the paged pool's head "
+                        "axis, the QKV/O projections, and the MLP over "
+                        "a tp mesh axis (serving/tp; one psum per "
+                        "row-parallel output, block tables replicated)."
+                        " Must divide the model's heads/mlp dims and "
+                        "fit the visible device count")
+    p.add_argument("--serve-replicas", type=int, default=d.serve_replicas,
+                   help="serving: data-parallel engine replicas fronted "
+                        "by the serving router (session-affinity "
+                        "placement + least-load admission over queue "
+                        "depth / pool occupancy / shed rate); each "
+                        "replica owns its own pool and scheduler")
     p.add_argument("--serve-deadline-ms", type=float,
                    default=d.serve_deadline_ms,
                    help="serving: default per-request TTL from arrival; "
@@ -240,6 +263,9 @@ def config_from_args(args) -> Config:
         serve_prefix_cache=args.serve_prefix_cache,
         serve_speculative=args.serve_speculative,
         serve_draft_k=args.serve_draft_k,
+        serve_draft_auto=args.serve_draft_auto,
+        serve_tp=args.serve_tp,
+        serve_replicas=args.serve_replicas,
         serve_deadline_ms=args.serve_deadline_ms,
         serve_queue_depth=args.serve_queue_depth,
         serve_max_evictions=args.serve_max_evictions,
@@ -305,6 +331,25 @@ def main(argv=None) -> int:
             f"bad --serve-speculative config: mode "
             f"{config.serve_speculative!r} (off|ngram|draft-model), "
             f"draft-k {config.serve_draft_k} (>= 1)")
+    if config.serve_draft_auto not in ("off", "on"):
+        raise SystemExit(
+            f"bad --serve-draft-auto {config.serve_draft_auto!r}: "
+            f"must be off|on")
+    if config.serve_draft_auto == "on" \
+            and config.serve_speculative == "off":
+        raise SystemExit(
+            "--serve-draft-auto on tunes the speculative draft window; "
+            "with --serve-speculative off it would be silently ignored "
+            "— pick a drafter or drop it")
+    if config.serve_tp < 1 or config.serve_replicas < 1:
+        # range guards only: head/mlp divisibility and the device-count
+        # bound need the model geometry and an initialized backend, so
+        # they live where both are known (serving/tp.check_geometry at
+        # engine construction)
+        raise SystemExit(
+            f"bad distributed-serving knobs: --serve-tp "
+            f"{config.serve_tp} (>= 1), --serve-replicas "
+            f"{config.serve_replicas} (>= 1)")
     if (config.serve_deadline_ms is not None
             and config.serve_deadline_ms <= 0) \
             or (config.serve_queue_depth is not None

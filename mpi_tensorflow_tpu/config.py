@@ -130,6 +130,27 @@ class Config:
     serve_draft_k: int = 4        # draft window: tokens proposed per
                                   # verify forward (dispatch width is
                                   # draft_k + 1)
+    serve_draft_auto: str = "off"  # auto-tune the draft window: "on"
+                                  # adapts the effective k to an EWMA
+                                  # of the observed accepted length,
+                                  # clamped to [1, serve_draft_k] (the
+                                  # dispatch width never changes, so
+                                  # no recompiles); "off" drafts the
+                                  # configured k every step
+    serve_tp: int = 1             # tensor-parallel shards for the
+                                  # decode engine: >1 partitions the
+                                  # paged pool's head axis, the QKV/O
+                                  # projections, and the MLP over a
+                                  # ``tp`` mesh axis (serving/tp) with
+                                  # one psum per row-parallel output;
+                                  # must divide the model's heads and
+                                  # mlp dims and fit the device count
+    serve_replicas: int = 1       # data-parallel engine replicas
+                                  # fronted by serving/router: each has
+                                  # its own pool/scheduler; requests
+                                  # place by session affinity then
+                                  # least-load (queue depth, occupancy,
+                                  # shed rate).  1 = no router layer
     # fault-tolerance policy (serving/engine.ServeConfig; None = off)
     serve_deadline_ms: Optional[float] = None  # default per-request TTL
                                   # from arrival; expired work fails

@@ -160,7 +160,7 @@ class CausalLm(bert_lib.BertMlm):
         return logits.astype(jnp.float32), new_cache
 
     def forward_paged(self, params, tokens, pools, block_tables, lengths,
-                      valid=None, kernel: str = "xla"):
+                      valid=None, kernel: str = "xla", reduce=None):
         """Forward ``tokens`` (B, S_in) through the PAGED KV cache: row
         ``b`` occupies absolute positions [lengths[b], lengths[b]+S_in),
         reading/writing the per-layer block pools (serving/paged_cache)
@@ -188,6 +188,14 @@ class CausalLm(bert_lib.BertMlm):
                       so the kernel can bound its block walk by live
                       tokens instead of relying on the visibility mask
                       alone
+        reduce:       manual-TP allreduce hook applied to each layer's
+                      row-parallel partial outputs (attention out-proj
+                      and MLP down-proj) BEFORE their bias — the
+                      serving tensor-parallel path (serving/tp) calls
+                      this under shard_map with heads/mlp (and the
+                      pool's head axis) sharded over a ``tp`` mesh axis
+                      and passes ``lax.psum`` here; None keeps the
+                      single-shard math byte-for-byte
 
         Returns (fp32 logits (B, S_in, V), updated pools).  The math
         shares ``forward_with_cache``'s layers AND its attention
@@ -231,13 +239,14 @@ class CausalLm(bert_lib.BertMlm):
             new_pools.append({"k": pk, "v": pv})
             a = paged_ops.attend(q, pk, pv, block_tables, lengths, dt,
                                  kernel=kernel)
-            a = bert_lib.attn_out_proj(lp, a, dt)
+            a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
             h = _layernorm(h + a, lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))
             m = bert_lib.gelu_mlp(
                 lp, h, dt,
                 constrain=lambda m_: self._constrain(
-                    m_, ("batch", "seq", "mlp")))
+                    m_, ("batch", "seq", "mlp")),
+                reduce=reduce)
             h = _layernorm(h + m, lp["ln2"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))
 

@@ -33,6 +33,15 @@ resolves through ``resolve_kernel`` to either
   on CPU for tests), or
 - ``xla``    — this module's gather + dense masked softmax, the
   always-available exact fallback (TPU-lowerable, CPU-exact).
+
+Tensor parallelism (serving/tp): every op here treats H as a PURE
+BATCH dimension — ``write_kv`` scatters per-head rows independently,
+``gather_kv``/``attend`` contract only within a head — so under a
+head-sharded pool each shard runs these ops unchanged over its local
+``H/tp`` heads with the SAME replicated block table (a block id
+addresses the same slot of every shard's pool).  Nothing in this
+module is tp-aware; the cross-shard reduction lives in the model's
+row-parallel projections, not in attention.
 """
 
 from __future__ import annotations

@@ -38,6 +38,18 @@ DEFAULT_RULES: dict[str, Optional[str]] = {
     "vchunk": None,      # interleaved virtual-chunk dim (1f1b_interleaved)
 }
 
+# Serving tensor-parallel rules (serving/tp): ONLY the head- and
+# mlp-sharded dims map to the ``tp`` axis — the Megatron column/row split
+# of attention and MLP.  embed/vocab/pos stay replicated so after the two
+# per-layer psum points (attention out-proj, MLP down-proj) every shard
+# holds the identical residual stream and computes identical logits; the
+# paged KV pool follows ``heads`` (its axis 1), which is why a block
+# table that indexes BLOCKS, not heads, replicates cleanly.
+SERVING_TP_RULES: dict[str, Optional[str]] = {
+    "heads": "tp",
+    "mlp": "tp",
+}
+
 
 def spec_for(logical_axes: tuple, rules: Mapping[str, Optional[str]],
              mesh: Mesh) -> PartitionSpec:

@@ -37,6 +37,15 @@ Six cooperating layers, host-side policy over device-side math:
                      supervisor: rebuild pools/engine on device loss and
                      replay live sequences token-identically (greedy
                      decode is deterministic).
+- ``tp``           — tensor parallelism for the engine: shard the
+                     head-major pool, QKV/O projections, and MLP over a
+                     ``tp`` mesh axis via shard_map (one psum per
+                     row-parallel output); block tables replicate, so
+                     every host-side layer above stays tp-unaware.
+- ``router``       — data-parallel scale-out: N whole engine replicas
+                     behind session-affinity placement and least-load
+                     admission over the schedulers' own health signals
+                     (queue depth, pool occupancy, shed rate).
 
 The decode math itself lives in models/gpt.CausalLm.forward_paged (the
 shared transformer stack) and ops/paged_attention (gather/scatter).
@@ -50,6 +59,8 @@ from mpi_tensorflow_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixCache)
 from mpi_tensorflow_tpu.serving.recovery import (  # noqa: F401
     ReplayJournal, run_with_replay)
+from mpi_tensorflow_tpu.serving.router import (  # noqa: F401
+    ReplicaRouter)
 from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401
     Request, RejectedRequest, Scheduler, TERMINAL_STATUSES)
 from mpi_tensorflow_tpu.serving.speculative import (  # noqa: F401

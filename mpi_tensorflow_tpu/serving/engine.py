@@ -20,6 +20,15 @@ bucketed shapes —
 block pools are donated through every dispatch on TPU, so the cache
 updates in place instead of ping-ponging two pool-sized buffers.
 
+Tensor parallelism (``--serve-tp N``): the jitted steps below run the
+forward through a shard_map seam (serving/tp) that partitions the
+head-major pool, QKV/O, and MLP over a ``tp`` mesh axis with one psum
+per row-parallel projection.  Block tables index blocks, not heads, so
+everything host-side in this file is tp-unaware; the seam is resolved
+once at construction, so TP adds no dispatch shapes and the
+zero-recompile contract holds unchanged.  Scale-OUT (whole-engine
+replicas) lives above this file in serving/router.
+
 Prefix sharing (``--serve-prefix-cache on``): admission walks each
 prompt through a radix trie of cached full blocks
 (serving/prefix_cache) and maps hits to EXISTING physical blocks, so
@@ -77,6 +86,28 @@ class ServeConfig:
                                   # tokens proposed per verify forward;
                                   # the verify dispatch width is k+1
                                   # and a step emits 1..k+1 tokens
+    draft_auto: str = "off"       # auto-tune the draft window (--serve-
+                                  # draft-auto): "on" shrinks/grows the
+                                  # EFFECTIVE k with an EWMA of the
+                                  # accepted length per verify step,
+                                  # clamped to [1, draft_k] (the floor
+                                  # keeps a 1-token probe alive so a
+                                  # recovering accept rate can re-grow
+                                  # it); dispatch width stays draft_k+1
+                                  # so the zero-recompile contract is
+                                  # untouched.  "off" drafts the full
+                                  # configured k every step
+    tp: int = 1                   # tensor-parallel shards (--serve-tp):
+                                  # >1 partitions the head-major pool,
+                                  # QKV/O projections, and MLP over a
+                                  # ``tp`` mesh axis via shard_map
+                                  # (serving/tp), psum-combining the
+                                  # row-parallel outputs; 1 keeps the
+                                  # single-device path byte-for-byte.
+                                  # Must divide the model's heads and
+                                  # mlp dims and fit the device count
+                                  # (checked at engine construction,
+                                  # where the model geometry is known)
     # --- fault-tolerance policy (None = feature off / unbounded) ---
     deadline_ms: Optional[float] = None   # default per-request TTL from
                                   # arrival; expired work fails with
@@ -108,6 +139,8 @@ class ServeConfig:
                     prefix_cache=config.serve_prefix_cache,
                     speculative=config.serve_speculative,
                     draft_k=config.serve_draft_k,
+                    draft_auto=config.serve_draft_auto,
+                    tp=config.serve_tp,
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
                     max_evictions=config.serve_max_evictions,
@@ -139,6 +172,16 @@ class ServeConfig:
         if self.draft_k < 1:
             raise ValueError(
                 f"serve draft_k must be >= 1, got {self.draft_k}")
+        if self.draft_auto not in ("off", "on"):
+            raise ValueError(
+                f"serve draft_auto must be off|on, got {self.draft_auto!r}")
+        if self.draft_auto == "on" and self.speculative == "off":
+            raise ValueError(
+                "serve draft_auto tunes the speculative draft window; "
+                "with speculative off it would be silently ignored — "
+                "pick a drafter or drop it")
+        if self.tp < 1:
+            raise ValueError(f"serve tp must be >= 1, got {self.tp}")
         if (self.deadline_ms is not None and self.deadline_ms <= 0) \
                 or (self.queue_depth is not None and self.queue_depth < 1) \
                 or (self.max_evictions is not None
@@ -186,7 +229,6 @@ class PagedDecodeEngine:
         from mpi_tensorflow_tpu.serving import speculative as spec_lib
 
         self.model = model
-        self.params = params
         self.serve = serve
         cap = serve.max_blocks_per_seq * serve.block_size
         if model.cfg.pos_kind == "learned" \
@@ -194,13 +236,37 @@ class PagedDecodeEngine:
             raise ValueError(
                 f"max_seq_len {serve.max_seq_len} (table capacity {cap}) "
                 f"exceeds max_positions {model.cfg.max_positions}")
+        # tensor parallelism (serving/tp): geometry checked HERE, where
+        # the model's head/mlp dims are known; the mesh, the sharded
+        # parameter placement, and the shard_map forward are all
+        # resolved once so TP is static under the jitted steps below
+        from mpi_tensorflow_tpu.serving import tp as tp_lib
+
+        tp_lib.check_geometry(model.cfg, serve.tp)
+        self.tp_mesh = (tp_lib.make_tp_mesh(serve.tp)
+                        if serve.tp > 1 else None)
         # resolve auto -> xla|pallas ONCE, host-side: the literal bakes
         # into the jitted steps below, so kernel choice cannot add
         # dispatch shapes or recompiles (the zero-recompile contract
-        # covers the kernel path by construction)
+        # covers the kernel path by construction).  Under TP each shard
+        # runs the kernel over its LOCAL heads, so the compile probe
+        # must see the per-shard head count
+        kcfg = (model.cfg if serve.tp == 1 else dataclasses.replace(
+            model.cfg, heads=model.cfg.heads // serve.tp))
         self.kernel = paged_ops.resolve_kernel(
-            serve.kernel, model.cfg, serve.block_size,
+            serve.kernel, kcfg, serve.block_size,
             serve.prefill_chunk)
+        if self.tp_mesh is not None:
+            self.params = tp_lib.shard_params(model, params, self.tp_mesh)
+            self._paged_forward = tp_lib.make_paged_forward(
+                model, self.tp_mesh, self.kernel)
+        else:
+            self.params = params
+            self._paged_forward = (
+                lambda params, tokens, pools, tables, lengths, valid:
+                model.forward_paged(params, tokens, pools, tables,
+                                    lengths, valid=valid,
+                                    kernel=self.kernel))
         # donate the pools so the TPU cache updates in place; CPU (the
         # test platform) does not implement donation — skip the arg to
         # keep the suite free of spurious donation warnings
@@ -221,6 +287,14 @@ class PagedDecodeEngine:
         self.drafter = spec_lib.make_drafter(
             serve.speculative, serve, model,
             draft_model=draft_model, draft_params=draft_params)
+        # draft-window auto-tuning (--serve-draft-auto on): EWMA of the
+        # accepted length per verify forward drives the EFFECTIVE k.
+        # Initialized optimistic (full window) and NOT cleared by
+        # reset(): like the jit caches, the learned window is warmed
+        # state a trace replay should keep — and it can never change
+        # emitted tokens, only how much draft work is attempted
+        self._accept_ewma = float(serve.draft_k)
+        self._draft_k_eff = serve.draft_k
         self.reset()
         if self.prefix_cache is not None:
             # pre-pay the CoW copy's single compile with a null-block
@@ -251,6 +325,13 @@ class PagedDecodeEngine:
 
         self.pools = paged_cache.init_pools(
             self.model.cfg, self.serve.num_blocks, self.serve.block_size)
+        if self.tp_mesh is not None:
+            # head-axis sharding (serving/tp): one block id addresses
+            # the same slot of every shard's local-heads pool, so the
+            # host allocator/scheduler/trie below stay tp-unaware
+            from mpi_tensorflow_tpu.serving import tp as tp_lib
+
+            self.pools = tp_lib.shard_pools(self.pools, self.tp_mesh)
         self.allocator = paged_cache.BlockAllocator(self.serve.num_blocks)
         # fresh trie with fresh pools: cached content lives in the pool,
         # so the two reset together (a stale trie would map new
@@ -315,9 +396,8 @@ class PagedDecodeEngine:
         from mpi_tensorflow_tpu.ops.paged_attention import NULL_BLOCK
 
         live = (tables[:, 0] != NULL_BLOCK)[:, None]
-        logits, pools = self.model.forward_paged(
-            params, tokens[:, None], pools, tables, lengths, valid=live,
-            kernel=self.kernel)
+        logits, pools = self._paged_forward(
+            params, tokens[:, None], pools, tables, lengths, live)
         nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         return nxt, pools
 
@@ -329,9 +409,8 @@ class PagedDecodeEngine:
 
         S = tokens.shape[1]
         valid = jnp.arange(S)[None] < n_real
-        logits, pools = self.model.forward_paged(
-            params, tokens, pools, tables, length[None], valid=valid,
-            kernel=self.kernel)
+        logits, pools = self._paged_forward(
+            params, tokens, pools, tables, length[None], valid)
         nxt = jnp.argmax(logits[0, jnp.maximum(n_real - 1, 0)], axis=-1)
         return nxt.astype(jnp.int32), pools
 
@@ -361,9 +440,8 @@ class PagedDecodeEngine:
         W = tokens.shape[1]
         live = tables[:, 0] != NULL_BLOCK
         valid = (jnp.arange(W)[None] < n_valid[:, None]) & live[:, None]
-        logits, pools = self.model.forward_paged(
-            params, tokens, pools, tables, lengths, valid=valid,
-            kernel=self.kernel)
+        logits, pools = self._paged_forward(
+            params, tokens, pools, tables, lengths, valid)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
 
     def _prewarm_verify(self) -> None:
@@ -595,8 +673,16 @@ class PagedDecodeEngine:
         serve = self.serve
         bs = serve.block_size
         cap = serve.max_blocks_per_seq * bs
+        # the step's draft-window cap: the configured k, or — under
+        # --serve-draft-auto on — the EWMA-tuned effective k (floor 1
+        # keeps a cheap probe alive so a recovering accept rate can
+        # re-grow the window; the verify dispatch width stays draft_k+1
+        # either way, so auto-tuning can never add a compile)
+        k_cap = (self._draft_k_eff if serve.draft_auto == "on"
+                 else serve.draft_k)
         live: List[int] = []
         drafts: dict = {}
+        full_window: dict = {}
         for slot in self.sched.live_slots():
             seq = self.sched.slots[slot]
             if seq is None or seq.prefilled < len(seq.request.prompt):
@@ -608,7 +694,13 @@ class PagedDecodeEngine:
             # the request's budget (k <= remaining - 1: at most
             # ``remaining`` tokens emitted) nor the table capacity
             remaining = seq.request.max_new_tokens - len(seq.generated)
-            k = min(serve.draft_k, remaining - 1, cap - seq.length)
+            k = min(k_cap, remaining - 1, cap - seq.length)
+            # whether this row was OFFERED the policy's full window: a
+            # row truncated by its budget, table capacity, or pool
+            # pressure necessarily accepts few tokens, which says
+            # nothing about the drafter — the auto-tune EWMA must not
+            # read truncation as inaccuracy
+            window_full = k >= k_cap
             draft: List[int] = []
             if k > 0:
                 ctx = list(seq.request.prompt) + seq.generated
@@ -619,7 +711,10 @@ class PagedDecodeEngine:
                 # with free blocks only — speculation never preempts
                 covered = self.sched.extend_for(slot,
                                                 seq.length + len(draft))
+                if covered - seq.length < len(draft):
+                    window_full = False
                 draft = draft[:max(0, covered - seq.length)]
+            full_window[slot] = window_full
             if not self._ensure_private(slot, seq.length - 1,
                                         seq.length + len(draft)):
                 self.sched.fail_live(slot, "rejected")
@@ -674,6 +769,19 @@ class PagedDecodeEngine:
             counters["spec_accepted"] += min(n_acc, len(emit))
             counters["spec_verify_forwards"] += 1
             counters["spec_emitted"] += len(emit)
+            # effective-k accounting + EWMA update: the window the
+            # policy would offer (k_cap) is what "effective k" means to
+            # the bench's speculation block; the EWMA tracks ACCEPTED
+            # length only over rows that drafted into a FULL window —
+            # a row with no draft, or one truncated by budget/capacity/
+            # pool pressure, says nothing about the drafter's accuracy
+            counters["spec_k_sum"] += k_cap
+            counters["spec_k_steps"] += 1
+            if serve.draft_auto == "on" and draft \
+                    and full_window.get(slot, False):
+                a = 0.2
+                self._accept_ewma = ((1 - a) * self._accept_ewma
+                                     + a * n_acc)
             self._last_token[slot] = emit[-1]
             rid = seq.request.id
             for tok in emit:
@@ -686,6 +794,14 @@ class PagedDecodeEngine:
                 # blocks past the accepted length — release them so the
                 # pool never retains entries no accepted token owns
                 self.sched.rollback_blocks(slot, seq.length)
+        if serve.draft_auto == "on":
+            # next step's window: one past the recent mean accepted
+            # length (draft what history says will land, plus one probe
+            # token of headroom), clamped to [1, configured k] — round,
+            # not ceil: a near-zero EWMA must reach the floor instead
+            # of parking one above it forever
+            self._draft_k_eff = max(1, min(
+                serve.draft_k, int(round(self._accept_ewma)) + 1))
         return emitted
 
     # ---------------- request loop ----------------
@@ -853,7 +969,8 @@ class PagedDecodeEngine:
 
         return speculation_block(
             self.sched.counters, enabled=self.drafter is not None,
-            mode=self.serve.speculative, draft_k=self.serve.draft_k)
+            mode=self.serve.speculative, draft_k=self.serve.draft_k,
+            draft_auto=self.serve.draft_auto)
 
     def compile_counts(self) -> dict:
         """Live jit-cache entry counts — THE zero-recompile probe: a

@@ -287,5 +287,6 @@ def run_with_replay(make_engine: Callable[[], "object"],
         res["speculation"] = speculation_block(
             totals, enabled=res["speculation"]["enabled"],
             mode=res["speculation"]["mode"],
-            draft_k=res["speculation"]["draft_k"])
+            draft_k=res["speculation"]["draft_k"],
+            draft_auto=res["speculation"].get("draft_auto", "off"))
     return res

@@ -1,0 +1,326 @@
+"""Data-parallel replica serving: a router over N decode engines.
+
+The engine (serving/engine) scales UP with ``--serve-tp`` — one logical
+pool, sharded over a mesh.  This layer scales OUT: ``N`` whole engine
+replicas, each with its own pool, scheduler, prefix trie, and drafter,
+fronted by one router that owns placement and (with the schedulers'
+bounded queues) load-aware admission.  Together they are the Orca-style
+distributed serving shape: aggregate KV capacity and tokens/sec grow
+with replicas instead of one device's pool.
+
+Placement policy, in order:
+
+1. **Session affinity** — a request carrying ``Request.session`` sticks
+   to the replica that served that session before.  The payoff is
+   locality of everything a replica accumulates per conversation: radix
+   prefix-cache blocks (a follow-up turn re-hits its own prefix trie),
+   draft-model KV state, and — in a real deployment — the network hop.
+2. **Least load** — sessionless requests (and a session's first
+   request) go to the replica minimizing a load score built from the
+   scheduler's OWN health signals: waiting-queue depth (each queued
+   request is a whole admission behind), live-slot fraction, pool
+   occupancy, and observed shed rate.  No new instrumentation: these
+   are exactly the scale signals the schedulers already expose.
+
+Placement can never change tokens: greedy decode is deterministic per
+request, so whichever replica serves a request emits exactly the stream
+a single-engine run would (pinned by tests/test_router.py).  Placement
+changes latency, terminal statuses under pressure, and throughput.
+
+Execution: ``run(..., parallel=True)`` drives each replica from its own
+thread — schedulers and pools are single-owner (only the replica's
+thread touches them), the router hands requests over through a locked
+inbox, and jax dispatch/blocking release the GIL so replicas' device
+work overlaps (the in-process stand-in for one-process-per-replica).
+``parallel=False`` interleaves all replicas round-robin on the calling
+thread — deterministic scheduling for tests.
+
+Scope: the router serves a fixed trace to completion.  Graceful drain
+(PreemptionGuard) and journaled crash recovery remain ENGINE-level
+features — `tick()` mirrors `engine.run`'s per-iteration accounting
+(latency cadence, eviction sample-discard) but does not wire guard or
+journal through; routing those per-replica, and sharing one iteration
+body with ``engine.run`` instead of mirroring it, is the
+next extension of ROADMAP item 1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from mpi_tensorflow_tpu.serving import scheduler as sched_lib
+
+
+def default_parallelism() -> bool:
+    """Whether threaded replica stepping can actually win on this host:
+    only with >1 usable core.  On a single core the GIL's switch
+    interval turns the thread ping-pong into pure overhead (measured
+    ~10x slower than sequential on a 1-core container), while
+    sequential round-robin matches a single engine minus dispatch
+    overhead — so 1 core steps sequentially, and the real speedup claim
+    belongs to multi-core (or multi-process / multi-chip) deployments."""
+    try:
+        return len(os.sched_getaffinity(0)) > 1
+    except AttributeError:            # platforms without affinity API
+        return (os.cpu_count() or 1) > 1
+
+
+class ReplicaRouter:
+    """Route requests across engine replicas; aggregate their results.
+
+    ``engines``: fully constructed ``PagedDecodeEngine`` replicas (they
+    may share model/params arrays — each still owns its pools and jit
+    caches).  ``reset()`` resets every replica (jit caches survive,
+    mirroring ``engine.reset``) and forgets session placements.
+    """
+
+    def __init__(self, engines: List):
+        if not engines:
+            raise ValueError("ReplicaRouter needs >= 1 engine replica")
+        self.engines = list(engines)
+        self._sticky: Dict[object, int] = {}    # session -> replica
+        self.placements: Dict[int, int] = {}    # request id -> replica
+        self._routed = [0] * len(self.engines)
+
+    def reset(self) -> None:
+        for eng in self.engines:
+            eng.reset()
+        self._sticky.clear()
+        self.placements.clear()
+        self._routed = [0] * len(self.engines)
+
+    # ---------------- placement ----------------
+
+    def load_score(self, i: int, inbox_depth: int = 0) -> float:
+        """One replica's load, from its scheduler's own signals.  Queue
+        depth dominates (integer weight per waiting request); live-slot
+        fraction, pool occupancy, and shed rate are sub-1 tie-breakers
+        that push new work away from saturated or shedding replicas."""
+        eng = self.engines[i]
+        sched = eng.sched
+        waiting = len(sched.waiting) + inbox_depth
+        live = sum(1 for s in sched.slots if s is not None)
+        occ = eng.allocator.num_used / max(1, eng.serve.num_blocks - 1)
+        shed_rate = sched.counters.get("shed", 0) / max(1, self._routed[i])
+        return (waiting
+                + live / max(1, eng.serve.max_slots) * 0.5
+                + occ * 0.3
+                + shed_rate * 0.2)
+
+    def route(self, req: sched_lib.Request,
+              inbox_depths: Optional[List[int]] = None) -> int:
+        """Pick the replica for ``req``: sticky session first, else
+        least-loaded (ties break to the lowest index, so an idle fleet
+        fills deterministically)."""
+        key = req.session
+        i = self._sticky.get(key) if key is not None else None
+        if i is None:
+            depths = inbox_depths or [0] * len(self.engines)
+            i = min(range(len(self.engines)),
+                    key=lambda j: (self.load_score(j, depths[j]), j))
+            if key is not None:
+                self._sticky[key] = i
+        self._routed[i] += 1
+        self.placements[req.id] = i
+        return i
+
+    # ---------------- the serve loop ----------------
+
+    def run(self, requests: List[sched_lib.Request],
+            time_fn=time.perf_counter, *,
+            parallel: Optional[bool] = None) -> dict:
+        """Serve ``requests`` (replayed against their ``arrival``
+        stamps) across the replicas to completion.  Latency semantics
+        match ``engine.run`` (per-token cadence, eviction discards);
+        the result adds a per-replica metrics list (queue depth, pool
+        occupancy, shed rate, tokens/sec — the acceptance signals) next
+        to the aggregated outputs/statuses/faults.
+
+        ``parallel``: None (default) auto-selects — threads when the
+        host has >1 usable core (``default_parallelism``), sequential
+        round-robin otherwise; True/False force a mode."""
+        if parallel is None:
+            parallel = default_parallelism()
+        n = len(self.engines)
+        pending = sorted(requests, key=lambda r: r.arrival)
+        inboxes = [deque() for _ in range(n)]
+        locks = [threading.Lock() for _ in range(n)]
+        token_times: List[dict] = [dict() for _ in range(n)]
+        last_emit: List[dict] = [dict() for _ in range(n)]
+        tokens_count = [0] * n
+        peak_queue = [0] * n
+        routing_done = threading.Event()
+        errors: List[BaseException] = []
+        t0 = time_fn()
+
+        def route_due(now: float) -> None:
+            while pending and pending[0].arrival <= now:
+                req = pending.pop(0)
+                depths = [len(b) for b in inboxes]
+                i = self.route(req, depths)
+                with locks[i]:
+                    inboxes[i].append(req)
+
+        def tick(i: int) -> bool:
+            """One engine iteration for replica ``i`` (same shape as
+            the body of ``engine.run``'s loop).  Returns whether any
+            work moved.  Only replica ``i``'s thread (or the sequential
+            caller) runs this — scheduler/pool state is single-owner."""
+            eng = self.engines[i]
+            with locks[i]:
+                todo = list(inboxes[i])
+                inboxes[i].clear()
+            now = time_fn() - t0
+            for req in todo:
+                if eng.serve.deadline_ms is not None \
+                        and req.deadline is None:
+                    req = dataclasses.replace(
+                        req,
+                        deadline=req.arrival + eng.serve.deadline_ms / 1e3)
+                if eng.sched.submit(req) is not None:
+                    continue        # terminal status recorded on replica
+                last_emit[i][req.id] = req.arrival
+                token_times[i][req.id] = []
+            peak_queue[i] = max(peak_queue[i], len(eng.sched.waiting))
+            eng.sched.expire_deadlines(now)
+            emitted = eng.step()
+            now = time_fn() - t0
+            for rid, tok in emitted:
+                if rid in last_emit[i]:
+                    token_times[i][rid].append(now - last_emit[i][rid])
+                    last_emit[i][rid] = now
+            tokens_count[i] += len(emitted)
+            for rid in eng.sched.evicted_ids:
+                # eviction discards the delivered-so-far latency sample,
+                # exactly as engine.run does
+                token_times[i][rid] = []
+                last_emit[i][rid] = now
+            eng.sched.evicted_ids.clear()
+            return bool(todo) or bool(emitted) or eng._progressed
+
+        if parallel:
+            def worker(i: int) -> None:
+                try:
+                    while True:
+                        progressed = tick(i)
+                        if not progressed:
+                            # observe routing_done BEFORE the inbox
+                            # snapshot: once the flag is set no append
+                            # can follow, so flag-then-empty is
+                            # conclusive — the reverse order races a
+                            # final route landing between the snapshot
+                            # and the flag read, silently dropping it
+                            done_routing = routing_done.is_set()
+                            with locks[i]:
+                                empty = not inboxes[i]
+                            if done_routing and empty \
+                                    and self.engines[i].sched.all_done():
+                                return
+                            time.sleep(1e-3)
+                except BaseException as e:   # noqa: BLE001 — re-raised
+                    errors.append(e)         # in the router thread below
+
+            threads = [threading.Thread(target=worker, args=(i,),
+                                        name=f"serve-replica-{i}",
+                                        daemon=True) for i in range(n)]
+            for t in threads:
+                t.start()
+            while pending and not errors:
+                now = time_fn() - t0
+                route_due(now)
+                if pending:
+                    time.sleep(min(1e-3, max(
+                        0.0, pending[0].arrival - (time_fn() - t0))))
+            routing_done.set()
+            for t in threads:
+                t.join()
+            if errors:
+                raise errors[0]
+        else:
+            routing_done.set()      # sequential: routing happens inline
+            while pending or not all(e.sched.all_done()
+                                     for e in self.engines):
+                now = time_fn() - t0
+                route_due(now)
+                progressed = False
+                for i in range(n):
+                    progressed = tick(i) or progressed
+                if not progressed:
+                    delay = 1e-3
+                    if pending:
+                        delay = min(delay, max(
+                            0.0, pending[0].arrival - (time_fn() - t0)))
+                    if delay > 0:
+                        time.sleep(delay)
+        elapsed = time_fn() - t0
+
+        # ---------------- aggregation ----------------
+        from collections import Counter
+
+        from mpi_tensorflow_tpu.utils.metrics_writer import faults_block
+
+        outputs: dict = {}
+        statuses: dict = {}
+        totals: Counter = Counter()
+        per_replica = []
+        for i, eng in enumerate(self.engines):
+            eng.sched.check_quiescent()
+            if eng.drafter is not None:
+                eng.drafter.check_quiescent()
+            for s in eng.sched.finished:
+                outputs[s.request.id] = list(s.generated)
+            statuses.update(eng.sched.statuses)
+            totals.update(eng.sched.counters)
+            routed = self._routed[i]
+            shed = int(eng.sched.counters.get("shed", 0))
+            per_replica.append({
+                "replica": i,
+                "requests_routed": routed,
+                "tokens": tokens_count[i],
+                "tokens_per_sec": (tokens_count[i] / elapsed
+                                   if elapsed > 0 else 0.0),
+                "queue_depth_peak": peak_queue[i],
+                "pool_occupancy_peak": round(
+                    eng.peak_blocks_in_use
+                    / max(1, eng.serve.num_blocks - 1), 4),
+                "peak_live_blocks": eng.peak_live_blocks,
+                "shed": shed,
+                "shed_rate": round(shed / max(1, routed), 4),
+                "evictions": eng.sched.evictions,
+                "faults": faults_block(eng.sched.counters),
+            })
+        flat = [x for per in token_times for ts in per.values()
+                for x in ts]
+        lat = np.asarray(flat) if flat else np.zeros(1)
+        total = sum(len(v) for v in outputs.values())
+        return {
+            "parallel": parallel,
+            "outputs": outputs,
+            "statuses": statuses,
+            "faults": faults_block(totals),
+            "replicas": per_replica,
+            "num_replicas": n,
+            "sticky_sessions": len(self._sticky),
+            "placements": dict(self.placements),
+            "tokens": total,
+            "elapsed_s": elapsed,
+            "tokens_per_sec": total / elapsed if elapsed > 0 else 0.0,
+            "p50_token_latency_ms": float(np.percentile(lat, 50)) * 1e3,
+            "p99_token_latency_ms": float(np.percentile(lat, 99)) * 1e3,
+        }
+
+    def compile_counts(self) -> dict:
+        """Per-replica jit-cache probes, keyed ``r<i>/<fn>`` — the
+        zero-recompile contract covers every replica's caches."""
+        out = {}
+        for i, eng in enumerate(self.engines):
+            for k, v in eng.compile_counts().items():
+                out[f"r{i}/{k}"] = v
+        return out

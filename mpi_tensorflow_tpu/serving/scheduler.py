@@ -64,6 +64,13 @@ class Request:
                                   # carries delivered tokens, so load
                                   # shedding must not drop it (the
                                   # feasibility check still applies)
+    session: Optional[object] = None  # conversation/session key for the
+                                  # replica router (serving/router):
+                                  # requests sharing a session stick to
+                                  # one replica, where their prefix
+                                  # blocks and drafter state live.
+                                  # None = no affinity (each request
+                                  # places independently by load)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -248,7 +255,19 @@ class Scheduler:
         suffix, so a hot system prompt costs its blocks ONCE across the
         whole pool.  The matched blocks are pinned (one reference) for
         the duration of the attempt, so the trie eviction that reclaim
-        may trigger can never free them out from under the admit."""
+        may trigger can never free them out from under the admit.
+
+        Hit-aware admission: ONLY when the head is block-starved (the
+        aging guard included could not unblock it), the rest of the
+        queue is scanned for the closest request whose cached prefix
+        makes it fit in the blocks actually free — its cached blocks
+        cost nothing and only its unique suffix takes free blocks, so
+        the pool does useful work instead of idling.  The suffix DOES
+        delay the head, which is why the bypass runs only while the
+        aging guard is armed (``starvation_steps`` not None): the
+        guard bounds how long the head can be bypassed before younger
+        live work is preempted for it.  With no pressure, admission
+        order stays strict FIFO (pinned by tests)."""
         admitted = []
         while self.waiting:
             slot = self.free_slot()
@@ -282,18 +301,66 @@ class Scheduler:
                     # appendleft would put younger work back in front of
                     # the very request the guard exists to unblock
                     continue
+                if self._admit_hit_aware(slot):
+                    # a cached-prefix request from behind the starved
+                    # head fit in the FREE blocks: keep admitting (the
+                    # head's starvation credit above keeps aging — the
+                    # bypass must not reset it)
+                    admitted.append(slot)
+                    continue
                 break
             self._head_blocked = 0
             self.waiting.popleft()
-            if self.prefix_cache is not None:
-                self.counters["prefix_prompt_tokens"] += len(req.prompt)
-                self.counters["prefix_hit_tokens"] += cached_tokens
-                self.counters["prefix_shared_blocks"] += len(cached_ids)
-            self.slots[slot] = Sequence(
-                req, cached_ids + self.allocator.alloc(need),
-                prefilled=cached_tokens, prefix_cached=cached_tokens)
+            self._admit_to(slot, req, cached_ids, cached_tokens, need)
             admitted.append(slot)
         return admitted
+
+    def _admit_to(self, slot: int, req: Request, cached_ids: List[int],
+                  cached_tokens: int, need: int) -> None:
+        """Install ``req`` into ``slot`` with its matched prefix blocks
+        plus ``need`` fresh ones — the one admission tail shared by the
+        FIFO path and the hit-aware bypass."""
+        if self.prefix_cache is not None:
+            self.counters["prefix_prompt_tokens"] += len(req.prompt)
+            self.counters["prefix_hit_tokens"] += cached_tokens
+            self.counters["prefix_shared_blocks"] += len(cached_ids)
+        self.slots[slot] = Sequence(
+            req, cached_ids + self.allocator.alloc(need),
+            prefilled=cached_tokens, prefix_cached=cached_tokens)
+
+    def _admit_hit_aware(self, slot: int) -> bool:
+        """The block-starved bypass: admit the closest queued request
+        whose cached prefix lets it fit in the blocks FREE RIGHT NOW
+        (``can_alloc``, not ``_reclaim`` — the bypass must neither
+        evict live work nor shrink the trie on behalf of younger
+        arrivals, and a candidate with no hits at all has no claim to
+        jump FIFO).  Disabled when the aging guard is off: the
+        jumper's unique suffix consumes free blocks the head is
+        waiting on, and without ``starvation_steps`` bounding the
+        head's wait that would be an unbounded-bypass liveness hole.
+        The scan is WINDOWED (closest 16 queued requests): admit() runs
+        every engine step, and each candidate costs a radix-trie walk
+        plus share/release refcount churn — an O(whole-queue) rescan
+        per step under sustained pressure would make admission itself
+        the hot path.  Returns whether a request was admitted."""
+        if self.prefix_cache is None or self.starvation_steps is None:
+            return False
+        for qi in range(1, min(len(self.waiting), 17)):
+            req = self.waiting[qi]
+            cached_ids, cached_tokens = \
+                self.prefix_cache.match_and_share(req.prompt)
+            if not cached_ids:
+                continue
+            need = blocks_for(len(req.prompt) + 1, self.block_size) \
+                - len(cached_ids)
+            if self.allocator.can_alloc(need):
+                del self.waiting[qi]
+                self.counters["prefix_hit_admissions"] += 1
+                self._admit_to(slot, req, cached_ids, cached_tokens,
+                               need)
+                return True
+            self.allocator.release(cached_ids)
+        return False
 
     # ---------------- per-step bookkeeping ----------------
 

@@ -117,11 +117,15 @@ def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0) -> dict:
         "cow_copies": int(counters.get("prefix_cow_copies", 0)),
         "trie_evictions": int(counters.get("prefix_trie_evictions", 0)),
         "trie_blocks": int(trie_blocks),
+        # block-starved admissions served out of FIFO order because a
+        # cached prefix made them fit (the scheduler's hit-aware
+        # admission policy); 0 when the pool never came under pressure
+        "hit_admissions": int(counters.get("prefix_hit_admissions", 0)),
     }
 
 
 def speculation_block(counters, *, enabled: bool, mode: str = "off",
-                      draft_k: int = 0) -> dict:
+                      draft_k: int = 0, draft_auto: str = "off") -> dict:
     """Normalize scheduler/supervisor counters into the canonical
     serving ``speculation`` (speculative decoding) accounting block —
     one constructor shared by engine results, the recovery
@@ -136,10 +140,18 @@ def speculation_block(counters, *, enabled: bool, mode: str = "off",
     accepted = int(counters.get("spec_accepted", 0))
     forwards = int(counters.get("spec_verify_forwards", 0))
     emitted = int(counters.get("spec_emitted", 0))
+    k_sum = int(counters.get("spec_k_sum", 0))
+    k_steps = int(counters.get("spec_k_steps", 0))
     return {
         "enabled": bool(enabled),
         "mode": mode,
         "draft_k": int(draft_k),
+        # the window the policy actually offered, averaged over verify
+        # steps: == draft_k with auto-tuning off; under --serve-draft-auto
+        # on this is THE number the knob exists to report
+        "draft_auto": draft_auto,
+        "effective_k": (round(k_sum / k_steps, 2) if k_steps
+                        else int(draft_k)),
         "draft_tokens": drafted,
         "accepted_tokens": accepted,
         "accept_rate": round(accepted / drafted, 4) if drafted else 0.0,

@@ -41,6 +41,17 @@ cd "$(dirname "$0")/.."
 
 T1_BUDGET=${T1_BUDGET:-870}
 
+# The persistent XLA:CPU AOT cache is poisoned CROSS-PROCESS on this
+# image: entries written by one process deterministically abort a LATER
+# process reloading them (crash sites test_checkpoint/test_elastic;
+# the round-trip canary passes, so utils/cache.py cannot detect it).
+# The cache is pure regenerable state — purge it up front instead of
+# relying on the manual `rm -rf .jax_cache` CHANGES.md keeps asking
+# for.  T1_KEEP_JAX_CACHE=1 opts out (e.g. on a host known clean).
+if [ "${T1_KEEP_JAX_CACHE:-0}" != "1" ]; then
+    rm -rf .jax_cache
+fi
+
 PYTEST_ARGS=("$@")
 if [ ${#PYTEST_ARGS[@]} -eq 0 ]; then
     PYTEST_ARGS=(tests/ -m 'not slow')
@@ -147,6 +158,18 @@ OPTS=()
 for a in "${PYTEST_ARGS[@]}"; do
     [ -e "${a%%::*}" ] || OPTS+=("$a")
 done
+
+# the known AOT-reload poisoning aborts in test_checkpoint/test_elastic:
+# a crash landing there means the cache regrown DURING run 1 is already
+# poisoned for the rerun process — purge it again (regenerable) so the
+# rerun starts from a clean slate
+case "${REMAIN[0]:-}" in
+    *test_checkpoint*|*test_elastic*)
+        echo "[t1_guard] crash in ${REMAIN[0]}: purging .jax_cache " \
+             "(known cross-process AOT-reload poisoning)"
+        rm -rf .jax_cache
+        ;;
+esac
 
 # rerun with the persistent compile cache OFF: the usual truncation
 # cause on this image is an AOT entry aborting on reload (utils/cache.py

@@ -1,0 +1,143 @@
+"""Data-parallel replica router (serving/router).
+
+Pins: placement can never change tokens (greedy determinism — routed
+outputs equal a single-engine run), session affinity sticks, load-aware
+placement steers new sessions away from loaded replicas, per-replica
+metrics carry the scheduler's health signals, and the threaded mode
+produces the same outputs as the deterministic sequential mode.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from mpi_tensorflow_tpu.models import bert, gpt
+from mpi_tensorflow_tpu.serving import (PagedDecodeEngine, ReplicaRouter,
+                                        Request, ServeConfig)
+
+TINY = dataclasses.replace(bert.BERT_TINY, ce_positions="all")
+BASE = dict(num_blocks=40, block_size=4, max_slots=3, max_seq_len=24,
+            prefill_chunk=8)
+
+
+def _model(seed=0):
+    import jax
+
+    model = gpt.CausalLm(TINY)
+    return model, model.init(jax.random.key(seed))
+
+
+def _trace(rng, n, sessions=None, budget_hi=8):
+    prompts = [list(map(int, rng.integers(0, TINY.vocab_size, int(s))))
+               for s in rng.integers(3, 13, n)]
+    budgets = [int(b) for b in rng.integers(1, budget_hi + 1, n)]
+    return [Request(i, p, b,
+                    session=(sessions[i] if sessions else None))
+            for i, (p, b) in enumerate(zip(prompts, budgets))]
+
+
+class TestPlacement:
+    def test_session_affinity_sticks(self):
+        model, params = _model()
+        router = ReplicaRouter([PagedDecodeEngine(model, params,
+                                                  ServeConfig(**BASE))
+                                for _ in range(3)])
+        rng = np.random.default_rng(1)
+        reqs = _trace(rng, 9, sessions=[i % 3 for i in range(9)])
+        res = router.run(reqs, parallel=False)
+        pl = res["placements"]
+        for s in range(3):
+            reps = {pl[i] for i in range(9) if i % 3 == s}
+            assert len(reps) == 1, \
+                f"session {s} split across replicas {reps}"
+        assert res["sticky_sessions"] == 3
+
+    def test_load_aware_routing_avoids_loaded_replica(self):
+        """With replica 0 already holding queued work, a sessionless
+        request must place on the idle replica 1."""
+        model, params = _model()
+        engines = [PagedDecodeEngine(model, params, ServeConfig(**BASE))
+                   for _ in range(2)]
+        router = ReplicaRouter(engines)
+        rng = np.random.default_rng(2)
+        filler = _trace(rng, 4)
+        for req in filler:
+            engines[0].sched.submit(req)          # queue depth 4 on r0
+        probe = Request(99, [1, 2, 3], 2)
+        assert router.route(probe) == 1
+        assert router.load_score(0) > router.load_score(1)
+
+    def test_router_needs_at_least_one_engine(self):
+        with pytest.raises(ValueError, match="1 engine"):
+            ReplicaRouter([])
+
+
+class TestRoutedServing:
+    def _single_and_router(self, n_replicas=2, seed=3, n_req=8,
+                           sessions=None):
+        model, params = _model(seed)
+        rng = np.random.default_rng(seed + 10)
+        reqs = _trace(rng, n_req, sessions=sessions)
+        single = PagedDecodeEngine(model, params, ServeConfig(**BASE))
+        router = ReplicaRouter([PagedDecodeEngine(model, params,
+                                                  ServeConfig(**BASE))
+                                for _ in range(n_replicas)])
+        return single, router, reqs
+
+    def test_outputs_token_identical_to_single_engine(self):
+        """Placement is invisible to content: the routed fleet emits
+        exactly the single engine's streams (greedy determinism)."""
+        single, router, reqs = self._single_and_router(
+            sessions=[i % 3 for i in range(8)])
+        want = single.run(list(reqs))["outputs"]
+        got = router.run(list(reqs), parallel=False)["outputs"]
+        assert got == want
+
+    def test_threaded_mode_matches_sequential(self):
+        single, router, reqs = self._single_and_router(seed=4)
+        want = single.run(list(reqs))["outputs"]
+        seq = router.run(list(reqs), parallel=False)["outputs"]
+        router.reset()
+        par = router.run(list(reqs), parallel=True)["outputs"]
+        assert seq == want and par == want
+
+    def test_per_replica_metrics_and_aggregates(self):
+        _, router, reqs = self._single_and_router(seed=5)
+        res = router.run(list(reqs), parallel=False)
+        assert res["num_replicas"] == 2
+        assert len(res["replicas"]) == 2
+        for blk in res["replicas"]:
+            for key in ("requests_routed", "tokens", "tokens_per_sec",
+                        "queue_depth_peak", "pool_occupancy_peak",
+                        "shed", "shed_rate", "evictions", "faults"):
+                assert key in blk, f"replica block missing {key}"
+        assert sum(b["requests_routed"] for b in res["replicas"]) == 8
+        assert sum(b["tokens"] for b in res["replicas"]) == res["tokens"]
+        assert res["tokens"] == sum(len(v)
+                                    for v in res["outputs"].values())
+
+    def test_reset_clears_placements_and_serves_again(self):
+        _, router, reqs = self._single_and_router(seed=6)
+        r1 = router.run(list(reqs), parallel=False)
+        router.reset()
+        assert router.placements == {} and router._sticky == {}
+        r2 = router.run(list(reqs), parallel=False)
+        assert r1["outputs"] == r2["outputs"]
+
+    def test_replica_shed_and_deadline_policies_apply_per_replica(self):
+        """A bounded queue on each replica sheds under a burst, and the
+        shed shows up in that replica's metrics block — the router's
+        admission signal."""
+        model, params = _model(7)
+        serve = ServeConfig(**{**BASE, "max_slots": 1},
+                            queue_depth=1)
+        router = ReplicaRouter([PagedDecodeEngine(model, params, serve)])
+        rng = np.random.default_rng(8)
+        reqs = _trace(rng, 6, budget_hi=4)       # burst at t=0, 1 slot,
+        res = router.run(reqs, parallel=False)   # queue bound 1
+        blk = res["replicas"][0]
+        assert blk["shed"] == res["faults"]["shed"] > 0
+        assert blk["shed_rate"] > 0
+        statuses = set(res["statuses"].values())
+        assert "shed" in statuses and "ok" in statuses

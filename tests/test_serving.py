@@ -494,6 +494,88 @@ class TestScheduler:
         a.check()
         pc.check()
 
+    def test_hit_aware_admission_only_under_pressure(self):
+        """THE hit-aware admission pin: a cached-prefix request jumps
+        an older uncached head ONLY when the head is block-starved —
+        with room for everyone, admission stays strict FIFO."""
+        # --- pressure: head cannot fit, the cached request can ---
+        a = BlockAllocator(6)                   # 5 usable
+        pc = PrefixCache(a, 4)
+        s = Scheduler(a, 2, 4, 4, prefix_cache=pc)
+        p0 = list(range(8))
+        s.submit(Request(0, p0, 4, arrival=0.0))
+        (slot0,) = s.admit()
+        seq0 = s.slots[slot0]
+        seq0.prefilled = 8
+        pc.insert(p0, seq0.block_ids)           # 2 full blocks cached
+        assert a.num_free == 2
+        s.submit(Request(1, [7] * 11, 4, arrival=1.0))   # needs 3 > 2
+        s.submit(Request(2, p0 + [9], 4, arrival=2.0))   # 2 cached + 1
+        admitted = s.admit()
+        assert len(admitted) == 1
+        assert s.slots[admitted[0]].request.id == 2, \
+            "cached-prefix request should bypass the starved head"
+        assert s.waiting[0].id == 1, "the head keeps its place in line"
+        assert s.counters["prefix_hit_admissions"] == 1
+        assert s.slots[admitted[0]].prefix_cached == 8
+        a.check()
+        pc.check()
+
+        # --- no pressure: strict FIFO, no queue jumping ---
+        a2 = BlockAllocator(32)
+        pc2 = PrefixCache(a2, 4)
+        s2 = Scheduler(a2, 3, 4, 4, prefix_cache=pc2)
+        p = list(range(8))
+        s2.submit(Request(0, p, 4, arrival=0.0))
+        (sl,) = s2.admit()
+        s2.slots[sl].prefilled = 8
+        pc2.insert(p, s2.slots[sl].block_ids)
+        s2.submit(Request(1, [7] * 11, 4, arrival=1.0))  # uncached, older
+        s2.submit(Request(2, p + [9], 4, arrival=2.0))   # cached, younger
+        order = [s2.slots[i].request.id for i in s2.admit()]
+        assert order == [1, 2], \
+            "without pressure admission must stay arrival order"
+        assert s2.counters["prefix_hit_admissions"] == 0
+
+    def test_hit_aware_bypass_disabled_without_aging_guard(self):
+        """The bypass's liveness story leans on the aging guard (the
+        jumper's suffix consumes free blocks the head waits on); with
+        starvation_steps=None the guard is off, so the bypass must be
+        too — the pre-change FIFO liveness guarantee holds."""
+        a = BlockAllocator(6)
+        pc = PrefixCache(a, 4)
+        s = Scheduler(a, 2, 4, 4, prefix_cache=pc,
+                      starvation_steps=None)
+        p0 = list(range(8))
+        s.submit(Request(0, p0, 4, arrival=0.0))
+        (slot0,) = s.admit()
+        s.slots[slot0].prefilled = 8
+        pc.insert(p0, s.slots[slot0].block_ids)
+        s.submit(Request(1, [7] * 11, 4, arrival=1.0))   # starved head
+        s.submit(Request(2, p0 + [9], 4, arrival=2.0))   # cached, fits
+        assert s.admit() == []
+        assert [r.id for r in s.waiting] == [1, 2]
+        assert s.counters["prefix_hit_admissions"] == 0
+
+    def test_hit_aware_bypass_requires_cache_hits(self):
+        """An uncached candidate has no claim to jump a starved head —
+        the bypass admits nothing and never evicts on its behalf."""
+        a = BlockAllocator(6)
+        pc = PrefixCache(a, 4)
+        s = Scheduler(a, 2, 4, 4, prefix_cache=pc)
+        p0 = list(range(8))
+        s.submit(Request(0, p0, 4, arrival=0.0))
+        (slot0,) = s.admit()
+        s.slots[slot0].prefilled = 8
+        pc.insert(p0, s.slots[slot0].block_ids)
+        s.submit(Request(1, [7] * 11, 4, arrival=1.0))   # starved head
+        s.submit(Request(2, [8] * 3, 4, arrival=2.0))    # fits, NO hits
+        assert s.admit() == []
+        assert [r.id for r in s.waiting] == [1, 2]
+        assert s.counters["prefix_hit_admissions"] == 0
+        assert s.evictions == 0
+        a.check()
+
     def test_scripted_trace_invariants(self):
         """Admit/decode/finish churn: at every step the pool partitions
         into free + exactly-the-live-sequences' blocks."""
@@ -939,7 +1021,7 @@ class TestPrefixCacheEngine:
         assert res["prefix"] == {
             "enabled": False, "hit_tokens": 0, "prompt_tokens": 0,
             "hit_rate": 0.0, "shared_blocks": 0, "cow_copies": 0,
-            "trie_evictions": 0, "trie_blocks": 0}
+            "trie_evictions": 0, "trie_blocks": 0, "hit_admissions": 0}
         assert res["outputs"][0] == res["outputs"][1] \
             == _generate_ref(model, params, p, 3)
         assert engine.allocator.num_used == 0
@@ -1026,6 +1108,46 @@ class TestServeCliGuards:
             ServeConfig.from_config(Config(serve_prefix_cache="maybe"))
         with pytest.raises(ValueError, match="prefix cache"):
             ServeConfig(prefix_cache="auto")
+
+    def test_distributed_serve_knobs_bridge(self):
+        """--serve-tp/--serve-replicas/--serve-draft-auto flow CLI ->
+        Config -> ServeConfig (replicas is a router-layer knob: it
+        bridges to Config and the bench, not the engine's config)."""
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(
+            ["--serve-tp", "2", "--serve-replicas", "3",
+             "--serve-draft-auto", "on",
+             "--serve-speculative", "ngram"])
+        c = cli.config_from_args(args)
+        assert (c.serve_tp, c.serve_replicas,
+                c.serve_draft_auto) == (2, 3, "on")
+        s = ServeConfig.from_config(c)
+        assert s.tp == 2 and s.draft_auto == "on"
+        s0 = ServeConfig.from_config(
+            cli.config_from_args(cli.build_parser().parse_args([])))
+        assert s0.tp == 1 and s0.draft_auto == "off"
+
+    def test_bad_distributed_serve_knobs_rejected(self):
+        """Range guards at cli.main and ServeConfig; the geometry
+        (heads/mlp divisibility, device bound) rejects at engine
+        construction where the model is known
+        (tests/test_serving_tp.py pins those)."""
+        from mpi_tensorflow_tpu import cli
+
+        with pytest.raises(SystemExit, match="serve-tp"):
+            cli.main(["--serve-tp", "0"])
+        with pytest.raises(SystemExit, match="serve-replicas"):
+            cli.main(["--serve-replicas", "0"])
+        with pytest.raises(ValueError, match="tp"):
+            ServeConfig(tp=0)
+        with pytest.raises(SystemExit):
+            cli.main(["--serve-draft-auto", "sometimes"])
+        # auto-tuning without a drafter would be silently ignored
+        with pytest.raises(SystemExit, match="draft-auto"):
+            cli.main(["--serve-draft-auto", "on"])
+        with pytest.raises(ValueError, match="draft_auto"):
+            ServeConfig(draft_auto="on", speculative="off")
 
     def test_serve_fault_knobs_bridge_to_serve_config(self):
         """The four fault-tolerance knobs flow CLI -> Config ->

@@ -402,6 +402,97 @@ class _WrongDrafter(Drafter):
                 for t in self.truth[rid][g:g + k]]
 
 
+class TestDraftAutoTune:
+    """--serve-draft-auto on: the EFFECTIVE draft window follows the
+    observed accept rate (EWMA, clamped to [1, draft_k]) while the
+    verify dispatch width — and therefore the compile set — never
+    changes, and emitted tokens never move."""
+
+    def test_always_wrong_drafter_shrinks_window_to_floor(self):
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        rng = np.random.default_rng(11)
+        prompts = [list(map(int, rng.integers(0, TINY.vocab_size, 5)))
+                   for _ in range(3)]
+        budget = 12
+        truth = {i: _generate_ref(model, params, p, budget)
+                 for i, p in enumerate(prompts)}
+        serve = dataclasses.replace(SERVE, speculative="ngram",
+                                    draft_k=4, draft_auto="on")
+        engine = PagedDecodeEngine(model, params, serve)
+        engine.drafter = _WrongDrafter(truth, dict(enumerate(prompts)),
+                                       TINY.vocab_size)
+        res = engine.run([Request(i, p, budget, arrival=0.0)
+                          for i, p in enumerate(prompts)])
+        # zero accepts: the EWMA decays and the window hits its floor —
+        # 1, never 0 (a dead window could never observe a recovery)
+        assert engine._draft_k_eff == 1
+        sp = res["speculation"]
+        assert sp["draft_auto"] == "on"
+        assert sp["effective_k"] < serve.draft_k, \
+            "auto-tuning never shrank the window"
+        for i in truth:
+            assert res["outputs"][i] == truth[i], \
+                "auto-tuning changed emitted tokens"
+        engine.sched.check_quiescent()
+
+    def test_self_draft_all_accept_keeps_full_window(self):
+        import jax
+
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        serve = dataclasses.replace(SERVE, speculative="draft-model",
+                                    draft_k=4, draft_auto="on")
+        spec = PagedDecodeEngine(model, params, serve,
+                                 draft_model=model, draft_params=params)
+        rng = np.random.default_rng(12)
+        reqs = _shared_trace(rng, n=4, budget=12)
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        sp = got["speculation"]
+        assert sp["accept_rate"] == 1.0
+        assert spec._draft_k_eff == serve.draft_k, \
+            "a fully-accepting drafter must keep the full window"
+        assert sp["effective_k"] == float(serve.draft_k)
+        for r in reqs:
+            assert got["outputs"][r.id] == _generate_ref(
+                model, params, r.prompt, r.max_new_tokens)
+
+    def test_auto_off_reports_the_configured_k(self):
+        model, params, off, spec = _pair(ROPE, key=5,
+                                         speculative="ngram", draft_k=3)
+        rng = np.random.default_rng(13)
+        reqs = _shared_trace(rng, n=3, budget=10)
+        got = spec.run([dataclasses.replace(r) for r in reqs])
+        sp = got["speculation"]
+        assert sp["draft_auto"] == "off"
+        assert sp["effective_k"] == float(3)
+
+    def test_zero_recompiles_with_auto_on(self):
+        """Shrinking/growing the effective k only changes n_valid lane
+        counts inside the FIXED draft_k+1 verify width — the jit caches
+        must not grow across a second trace."""
+        import jax
+
+        model = gpt.CausalLm(ROPE)
+        params = model.init(jax.random.key(1))
+        serve = dataclasses.replace(SERVE, speculative="ngram",
+                                    draft_k=4, draft_auto="on")
+        engine = PagedDecodeEngine(model, params, serve)
+
+        def trace(seed):
+            r = np.random.default_rng(seed)
+            return _shared_trace(r, n=4, budget=12)
+
+        engine.run(trace(0))
+        warm = engine.compile_counts()
+        engine.reset()
+        engine.run(trace(9))
+        assert engine.compile_counts() == warm, \
+            "draft-window auto-tuning recompiled"
+
+
 class TestRollback:
     def test_rejected_draft_blocks_released_and_quiescent(self):
         """THE rollback pin: with an always-wrong drafter, every verify
