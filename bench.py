@@ -417,7 +417,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     spec_ab: bool = False,
                     draft_auto: str | None = None,
                     tp: int | None = None,
-                    replicas: int | None = None) -> dict:
+                    replicas: int | None = None,
+                    fault_replica: int | None = None,
+                    fault_step: int | None = None,
+                    fault_kind: str = "transient") -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic Poisson request trace.
 
@@ -577,10 +580,25 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     replicas = 1 if replicas is None else replicas
     if replicas < 1:
         raise ValueError(f"--serve-replicas must be >= 1, got {replicas}")
-    if replicas > 1 and journal is not None:
-        raise ValueError("--serve-replicas adds a routed multi-engine "
-                         "arm; the journaled serve mode is a single "
-                         "supervised engine — pick one")
+    if (fault_replica is None) != (fault_step is None):
+        raise ValueError("--serve-fault-replica and --serve-fault-step "
+                         "name one injected fault together — set both "
+                         "or neither")
+    if fault_kind not in ("transient", "permanent"):
+        raise ValueError(f"--serve-fault-kind must be "
+                         f"transient|permanent, got {fault_kind!r}")
+    if fault_replica is not None:
+        if replicas < 2:
+            raise ValueError("--serve-fault-* injects a replica fault "
+                             "into the routed fleet; it needs "
+                             "--serve-replicas >= 2 so a survivor can "
+                             "take the migrated work")
+        if not 0 <= fault_replica < replicas:
+            raise ValueError(f"--serve-fault-replica {fault_replica} "
+                             f"outside the fleet [0, {replicas})")
+        if fault_step < 1:
+            raise ValueError(f"--serve-fault-step must be >= 1, got "
+                             f"{fault_step}")
     if replicas > 1 and (kernel_ab or spec_ab):
         raise ValueError("--serve-replicas adds its own comparison arm "
                          "(aggregate vs single engine); combining it "
@@ -647,6 +665,83 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                 for i in range(num_requests)]
 
     from mpi_tensorflow_tpu.train.preemption import PreemptionGuard
+
+    fault_plan = None
+    if fault_replica is not None:
+        from mpi_tensorflow_tpu.serving.router import (FaultPlan,
+                                                       ReplicaFault)
+
+        fault_plan = FaultPlan([ReplicaFault(fault_replica, fault_step,
+                                             kind=fault_kind)])
+
+    if journal is not None and replicas > 1:
+        # fault-tolerant FLEET serve mode: journaling is per-replica
+        # (``<journal>.r<i>``), failover/drain run inside the router,
+        # and a SIGKILLed run relaunched with the same --serve-journal
+        # resumes by replaying every journal's live entries through the
+        # fleet — merged outputs token-identical to an unfaulted run
+        from mpi_tensorflow_tpu.serving import recovery
+        from mpi_tensorflow_tpu.serving.router import ReplicaRouter
+
+        engagement.reset()
+        journals = [recovery.ReplayJournal(f"{journal}.r{i}")
+                    for i in range(replicas)]
+        todo, pre = recovery.fleet_replay_requests(
+            journals, trace(), eos_id=serve.eos_id)
+        router = ReplicaRouter(
+            [PagedDecodeEngine(model, params, serve)
+             for _ in range(replicas)])
+        with PreemptionGuard.installed() as guard:
+            rr = router.run(todo, guard=guard, journals=journals,
+                            replay_pre=pre, fault_plan=fault_plan)
+        return {
+            "model": "gpt_tiny" if tiny else "gpt_base",
+            "kernel": router.engines[0].kernel,
+            "kernel_requested": kernel or cfg.serve_kernel,
+            "roofline": _roofline(router.engines[0].kernel),
+            "serve_prefix_cache": serve.prefix_cache,
+            "serve_prefix_tokens": prefix_tokens,
+            "serve_speculative": serve.speculative,
+            "serve_draft_k": serve.draft_k,
+            "serve_draft_auto": serve.draft_auto,
+            "serve_tp": serve.tp,
+            "serve_replicas": replicas,
+            "serving_tokens_per_sec": rr["tokens_per_sec"],
+            "p50_token_latency_ms": rr["p50_token_latency_ms"],
+            "p99_token_latency_ms": rr["p99_token_latency_ms"],
+            "static_batch_tokens_per_sec": None,
+            "speedup_vs_static": None,
+            "tokens": rr["tokens"],
+            "elapsed_s": rr["elapsed_s"],
+            "outputs": rr["outputs"],
+            "statuses": rr["statuses"],
+            "status_counts": dict(Counter(rr["statuses"].values())),
+            "faults": rr["faults"],
+            "fleet_faults": rr["fleet_faults"],
+            "drain": rr["drain"],
+            "health": rr["health"],
+            "replicas": {
+                "n": replicas,
+                "parallel": rr["parallel"],
+                "per_replica": rr["replicas"],
+                "aggregate_tokens_per_sec": rr["tokens_per_sec"],
+                "sticky_sessions": rr["sticky_sessions"],
+                "fleet_faults": rr["fleet_faults"],
+            },
+            "serve_fault": (None if fault_replica is None else {
+                "replica": fault_replica, "step": fault_step,
+                "kind": fault_kind}),
+            "journal": journal,
+            "paths": engagement.snapshot(),
+            "num_requests": num_requests, "rate_rps": rate_rps,
+            "max_slots": max_slots, "pool_blocks": pool_blocks,
+            "block_size": block_size, "prompt_max": prompt_max,
+            "output_max": output_max, "max_seq_len": max_seq_len,
+            "deadline_ms": deadline_ms, "queue_depth": queue_depth,
+            "max_evictions": max_evictions, "drain_ms": drain_ms,
+            "tiny": tiny, "precision": precision,
+            "platform": jax.devices()[0].platform,
+        }
 
     if journal is not None:
         # fault-tolerant serve mode: one journaled pass through the
@@ -836,9 +931,19 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                                 for _ in range(replicas)])
         router.run(trace())
         router.reset()
-        rr = router.run(trace())
+        # the fault plan (if any) injects into the TIMED replay only:
+        # the warmup replay exists to pay bucket compiles, and a fault
+        # there would consume the one-shot plan before the arm it is
+        # meant to exercise.  Token identity to the single engine must
+        # hold across the failover — replay-by-prefix is exact.
+        rr = router.run(trace(), fault_plan=fault_plan)
         replicas_detail = {
             "n": replicas,
+            "fleet_faults": rr["fleet_faults"],
+            "health": rr["health"],
+            "serve_fault": (None if fault_replica is None else {
+                "replica": fault_replica, "step": fault_step,
+                "kind": fault_kind}),
             # threads on multi-core hosts (replica device work
             # overlaps); sequential round-robin on a single core,
             # where the threaded ping-pong is pure GIL overhead and
@@ -1278,6 +1383,16 @@ def _stale_score(args, d: dict, item=None):
                 (getattr(args, "serve_replicas", None)
                  or serve_defaults.serve_replicas):
             return None
+        # an injected replica fault makes the routed arm a failover
+        # exercise, not a clean throughput measurement: neither a
+        # fault-injecting REQUEST nor a faulted RECORD may stand in
+        # (absent keys on old records read as the pre-fleet-fault
+        # default: no injection)
+        if getattr(args, "serve_fault_replica", None) is not None \
+                or d.get("serve_fault") is not None \
+                or (d.get("replicas") or {}).get("serve_fault") \
+                is not None:
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1668,6 +1783,21 @@ def main(argv=None) -> int:
                          "replica), reporting per-replica queue depth/"
                          "occupancy/shed rate/tokens-per-sec and the "
                          "aggregate-vs-single speedup")
+    ap.add_argument("--serve-fault-replica", type=int, default=None,
+                    help="serving: inject one replica fault into the "
+                         "routed arm — kill this replica (index into "
+                         "--serve-replicas) and fail its work over to "
+                         "the survivors; outputs must stay token-"
+                         "identical (the fleet determinism pin)")
+    ap.add_argument("--serve-fault-step", type=int, default=None,
+                    help="serving: the replica tick the injected fault "
+                         "fires at (pair with --serve-fault-replica)")
+    ap.add_argument("--serve-fault-kind",
+                    choices=["transient", "permanent"],
+                    default="transient",
+                    help="serving: injected fault class — transient "
+                         "(replica ejected, probed back in after "
+                         "backoff) or permanent (stays dead)")
     ap.add_argument("--serve-spec-ab", action="store_true",
                     help="serving mode: TIME the speculation-off "
                          "control arm too (own warmup, own zero-"
@@ -1790,11 +1920,29 @@ def main(argv=None) -> int:
         ap.error(f"--serve-replicas must be >= 1, got "
                  f"{args.serve_replicas}")
     if args.serve_replicas is not None and args.serve_replicas > 1 \
-            and (args.serve_kernel_ab or args.serve_spec_ab
-                 or args.serve_journal is not None):
+            and (args.serve_kernel_ab or args.serve_spec_ab):
+        # NOTE: --serve-replicas + --serve-journal is now a SUPPORTED
+        # combination (the fault-tolerant fleet serve mode with one
+        # journal per replica); only the two-timed-arms A/B modes stay
+        # mutually exclusive with the routed arm
         ap.error("--serve-replicas adds its own routed arm (aggregate "
                  "vs single engine); combine with --serve-kernel-ab/"
-                 "--serve-spec-ab/--serve-journal one at a time")
+                 "--serve-spec-ab one at a time")
+    if (args.serve_fault_replica is not None
+            or args.serve_fault_step is not None
+            or args.serve_fault_kind != "transient") \
+            and args.mode != "serving":
+        ap.error("--serve-fault-* inject a replica fault into the "
+                 "serving fleet; other modes would silently ignore "
+                 "them")
+    if (args.serve_fault_replica is None) != (args.serve_fault_step
+                                              is None):
+        ap.error("--serve-fault-replica and --serve-fault-step name "
+                 "one injected fault together — set both or neither")
+    if args.serve_fault_replica is not None \
+            and (args.serve_replicas is None or args.serve_replicas < 2):
+        ap.error("--serve-fault-* need --serve-replicas >= 2 so a "
+                 "survivor can take the migrated work")
     if args.serve_draft_auto == "on" \
             and args.serve_speculative in (None, "off"):
         ap.error("--serve-draft-auto on tunes the speculative draft "
@@ -1887,7 +2035,10 @@ def main(argv=None) -> int:
                             spec_ab=args.serve_spec_ab,
                             draft_auto=args.serve_draft_auto,
                             tp=args.serve_tp,
-                            replicas=args.serve_replicas)
+                            replicas=args.serve_replicas,
+                            fault_replica=args.serve_fault_replica,
+                            fault_step=args.serve_fault_step,
+                            fault_kind=args.serve_fault_kind)
         return _report(args, r)
 
     if args.mode == "decode":

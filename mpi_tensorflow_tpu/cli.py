@@ -211,6 +211,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "many times fails with evicted_too_often "
                         "instead of requeueing forever (default: "
                         "unbounded)")
+    p.add_argument("--serve-failover-backoff-ms", type=float,
+                   default=d.serve_failover_backoff_ms,
+                   help="serving replica circuit breaker: base probe "
+                        "backoff after a transient replica fault "
+                        "(doubled per consecutive fault, capped at "
+                        "64x) before the router rebuilds and probes "
+                        "the replica back in (serving/router)")
     p.add_argument("--serve-drain-ms", type=float,
                    default=d.serve_drain_ms,
                    help="serving: graceful-drain budget after SIGTERM — "
@@ -270,6 +277,7 @@ def config_from_args(args) -> Config:
         serve_queue_depth=args.serve_queue_depth,
         serve_max_evictions=args.serve_max_evictions,
         serve_drain_ms=args.serve_drain_ms,
+        serve_failover_backoff_ms=args.serve_failover_backoff_ms,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
@@ -357,13 +365,15 @@ def main(argv=None) -> int:
             or (config.serve_max_evictions is not None
                 and config.serve_max_evictions < 1) \
             or (config.serve_drain_ms is not None
-                and config.serve_drain_ms < 0):
+                and config.serve_drain_ms < 0) \
+            or config.serve_failover_backoff_ms <= 0:
         raise SystemExit(
             f"bad --serve-* fault policy: deadline-ms "
             f"{config.serve_deadline_ms} (> 0), queue-depth "
             f"{config.serve_queue_depth} (>= 1), max-evictions "
             f"{config.serve_max_evictions} (>= 1), drain-ms "
-            f"{config.serve_drain_ms} (>= 0)")
+            f"{config.serve_drain_ms} (>= 0), failover-backoff-ms "
+            f"{config.serve_failover_backoff_ms} (> 0)")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

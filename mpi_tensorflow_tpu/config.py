@@ -167,6 +167,13 @@ class Config:
                                   # after SIGTERM; in-flight work past
                                   # it is cut with status `drained`
                                   # (None = finish all in-flight)
+    serve_failover_backoff_ms: float = 50.0    # replica circuit
+                                  # breaker (serving/router): base
+                                  # probe backoff after a transient
+                                  # replica fault, doubled per
+                                  # consecutive fault and capped at
+                                  # 64x before the replica is rebuilt
+                                  # and probed back into rotation
 
     # --- checkpointing (absent from the reference; SURVEY.md §5) ---
     checkpoint_dir: Optional[str] = None   # None = checkpointing off

@@ -32,20 +32,32 @@ Six cooperating layers, host-side policy over device-side math:
                      so steady-state serving updates the cache in place
                      and never recompiles after bucket warmup; graceful
                      SIGTERM drain via train/preemption.PreemptionGuard.
+- ``iteration``    — THE shared per-iteration serving body (submit
+                     stamping, deadline sweep, latency cadence,
+                     eviction discard, journal wiring) both
+                     ``engine.run`` and the router's replicas drive —
+                     guard/journal/drain semantics live in exactly one
+                     place.
 - ``recovery``     — host-side replay journal (prompt + generated
                      prefix per request) and the transient-failure
                      supervisor: rebuild pools/engine on device loss and
                      replay live sequences token-identically (greedy
-                     decode is deterministic).
+                     decode is deterministic); plus the fleet journal
+                     merge/replay helpers the router's failover uses.
 - ``tp``           — tensor parallelism for the engine: shard the
                      head-major pool, QKV/O projections, and MLP over a
                      ``tp`` mesh axis via shard_map (one psum per
                      row-parallel output); block tables replicate, so
                      every host-side layer above stays tp-unaware.
-- ``router``       — data-parallel scale-out: N whole engine replicas
-                     behind session-affinity placement and least-load
-                     admission over the schedulers' own health signals
-                     (queue depth, pool occupancy, shed rate).
+- ``router``       — data-parallel scale-out WITH fleet fault
+                     tolerance: N whole engine replicas (each with its
+                     own replay journal) behind session-affinity +
+                     health-gated least-load placement; a failed
+                     replica's live work migrates to survivors by
+                     journal-prefix replay (token-identical), a
+                     per-replica circuit breaker ejects/probes/readmits
+                     on capped exponential backoff, and SIGTERM drains
+                     the whole fleet.
 
 The decode math itself lives in models/gpt.CausalLm.forward_paged (the
 shared transformer stack) and ops/paged_attention (gather/scatter).
@@ -57,10 +69,13 @@ from mpi_tensorflow_tpu.serving.paged_cache import (  # noqa: F401
     BlockAllocator, init_pools)
 from mpi_tensorflow_tpu.serving.prefix_cache import (  # noqa: F401
     PrefixCache)
+from mpi_tensorflow_tpu.serving.iteration import (  # noqa: F401
+    DrainTracker, EngineLoop)
 from mpi_tensorflow_tpu.serving.recovery import (  # noqa: F401
-    ReplayJournal, run_with_replay)
+    ReplayJournal, fleet_outputs, fleet_replay_requests, fleet_statuses,
+    run_with_replay)
 from mpi_tensorflow_tpu.serving.router import (  # noqa: F401
-    ReplicaRouter)
+    FaultPlan, ReplicaFault, ReplicaRouter)
 from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401
     Request, RejectedRequest, Scheduler, TERMINAL_STATUSES)
 from mpi_tensorflow_tpu.serving.speculative import (  # noqa: F401

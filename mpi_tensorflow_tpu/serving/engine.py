@@ -124,6 +124,12 @@ class ServeConfig:
                                   # stop request (SIGTERM): in-flight
                                   # work past it is cut with status
                                   # `drained` (None = finish in flight)
+    failover_backoff_ms: float = 50.0     # replica circuit breaker
+                                  # (serving/router): base probe backoff
+                                  # after a transient replica fault —
+                                  # doubled per consecutive fault, capped
+                                  # at 64x, before the router rebuilds
+                                  # the replica and probes it back in
 
     @classmethod
     def from_config(cls, config, **overrides):
@@ -144,7 +150,8 @@ class ServeConfig:
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
                     max_evictions=config.serve_max_evictions,
-                    drain_ms=config.serve_drain_ms)
+                    drain_ms=config.serve_drain_ms,
+                    failover_backoff_ms=config.serve_failover_backoff_ms)
         base.update({k: v for k, v in overrides.items() if v is not None})
         return cls(**base)
 
@@ -186,7 +193,8 @@ class ServeConfig:
                 or (self.queue_depth is not None and self.queue_depth < 1) \
                 or (self.max_evictions is not None
                     and self.max_evictions < 1) \
-                or (self.drain_ms is not None and self.drain_ms < 0):
+                or (self.drain_ms is not None and self.drain_ms < 0) \
+                or self.failover_backoff_ms <= 0:
             raise ValueError(f"bad fault-tolerance policy: {self}")
         if self.num_blocks - 1 < self.max_blocks_per_seq:
             # a lone max-length sequence must fit, or the scheduler can
@@ -830,72 +838,43 @@ class PagedDecodeEngine:
         ``faults`` health-counter block, and the ``drain`` outcome next
         to the existing throughput/latency numbers.
         """
+        from mpi_tensorflow_tpu.serving.iteration import (DrainTracker,
+                                                          EngineLoop)
+
         serve = self.serve
-        if serve.deadline_ms is not None:
-            # the default TTL: deadline = arrival + budget on the run's
-            # clock; an explicit per-request deadline wins
-            requests = [r if r.deadline is not None else
-                        dataclasses.replace(
-                            r, deadline=r.arrival + serve.deadline_ms / 1e3)
-                        for r in requests]
-        # terminal routing (journal record_end + drafter release) runs
-        # through the engine's chained _on_terminal hook, already
-        # installed on the scheduler at reset()
-        self._journal = journal
+        # the shared per-iteration body (serving/iteration): submit
+        # stamping, journal wiring (terminal routing runs through the
+        # engine's chained _on_terminal hook, already installed on the
+        # scheduler at reset()), latency cadence, eviction discard —
+        # ONE implementation, also driven per-replica by the router
+        loop = EngineLoop(self, journal)
+        drain = DrainTracker(serve.drain_ms)
         pending = sorted(requests, key=lambda r: r.arrival)
-        token_times: dict = {}                  # request id -> [latency]
-        last_emit: dict = {}                    # request id -> stamp
-        draining, drain_t0, fin_at_drain, shed_at_drain = False, 0.0, 0, 0
         t0 = time_fn()
         while pending or not self.sched.all_done():
             now = time_fn() - t0
-            if guard is not None and guard.should_stop and not draining:
+            if guard is not None and guard.should_stop \
+                    and not drain.draining:
                 # graceful drain: stop admission, shed everything not in
                 # flight, let live sequences finish inside the budget
-                draining = True
-                drain_t0 = now
-                fin_at_drain = len(self.sched.finished)
-                shed_at_drain = len(pending)
+                drain.start(now, len(self.sched.finished))
+                drain.shed = len(pending)
                 for req in pending:
                     self.sched.fail_request(req, "shed")
                 pending = []
-                shed_at_drain += self.sched.shed_waiting()
-            if draining and serve.drain_ms is not None \
-                    and (now - drain_t0) * 1e3 > serve.drain_ms:
+                drain.shed += self.sched.shed_waiting()
+            if drain.expired(now):
                 # budget's hard edge: cut whatever is still in flight
                 self.sched.abort_live("drained")
                 break
             while pending and pending[0].arrival <= now:
-                req = pending.pop(0)
-                if journal is not None:
-                    journal.record_submit(req)
-                rej = self.sched.submit(req)
-                if rej is not None:
-                    continue    # terminal status recorded; engine lives
-                last_emit[req.id] = req.arrival
-                token_times[req.id] = []
-            # deadline sweep BEFORE the step: expired work must not buy
-            # another dispatch's worth of pool time
-            self.sched.expire_deadlines(now)
-            # step() journals each token at emission, BEFORE the terminal
-            # hook can fire — the durable order is tok-then-end, so an
+                loop.submit(pending.pop(0))
+            # deadline sweep + step + emit/evict accounting; step()
+            # journals each token at emission, BEFORE the terminal hook
+            # can fire — the durable order is tok-then-end, so an
             # end-ok can never precede its own finishing token
-            emitted = self.step()
+            emitted = loop.iterate(now, time_fn, t0)
             now = time_fn() - t0
-            for rid, tok in emitted:
-                if rid in last_emit:
-                    token_times[rid].append(now - last_emit[rid])
-                    last_emit[rid] = now
-            # AFTER the emit accounting: an eviction discards the
-            # request's samples so far — including a token emitted this
-            # very step (prefill-final then evicted by a later slot's
-            # ensure_block); only the final delivered stream counts
-            for rid in self.sched.evicted_ids:
-                if journal is not None:
-                    journal.record_evict(rid)
-                token_times[rid] = []
-                last_emit[rid] = now
-            self.sched.evicted_ids.clear()
             if not emitted and not self._progressed:
                 # no work moved this iteration (idle gap before the next
                 # arrival, or live-but-stalled slots): sleep instead of
@@ -916,7 +895,7 @@ class PagedDecodeEngine:
         outputs = {s.request.id: list(s.generated)
                    for s in self.sched.finished}
         total = sum(len(v) for v in outputs.values())
-        flat = [x for ts in token_times.values() for x in ts]
+        flat = loop.latencies()
         lat = np.asarray(flat) if flat else np.zeros(1)
         from mpi_tensorflow_tpu.utils.metrics_writer import faults_block
 
@@ -924,15 +903,8 @@ class PagedDecodeEngine:
             "outputs": outputs,
             "statuses": dict(self.sched.statuses),
             "faults": faults_block(self.sched.counters),
-            "drain": {
-                "requested": draining,
-                # finished after the stop request = drained to completion
-                "drained": len(self.sched.finished) - fin_at_drain
-                if draining else 0,
-                "shed": shed_at_drain if draining else 0,
-                "cut": int(self.sched.counters["drained"]),
-                "budget_ms": serve.drain_ms,
-            },
+            "drain": drain.result(len(self.sched.finished),
+                                  self.sched.counters["drained"]),
             "kernel": self.kernel,
             "prefix": self.prefix_block(),
             "speculation": self.speculation_block(),

@@ -187,24 +187,139 @@ class ReplayJournal:
                 continue
             if ent.status is not None:
                 continue
-            done = ent.delivered
-            if eos_id is not None and eos_id in done:
-                done = done[:done.index(eos_id) + 1]
-            remaining = ent.max_new_tokens + len(ent.pre) - len(done)
-            if remaining <= 0 or (eos_id is not None and done
-                                  and done[-1] == eos_id):
+            rep, done = replay_one(ent, req, eos_id)
+            if rep is None:
                 # crashed between the final token and its end record
                 self.record_end(req, "ok")
                 continue
             self._pending_pre[req.id] = done
-            out.append(Request(req.id, list(req.prompt) + done, remaining,
-                               arrival=0.0, replayed=True))
+            out.append(rep)
         return out
 
     def outputs(self) -> Dict[int, List[int]]:
         """Delivered streams of every completed (``ok``) request."""
         return {rid: ent.delivered for rid, ent in self.entries.items()
                 if ent.status == "ok"}
+
+
+def replay_one(ent: JournalEntry, req: Request,
+               eos_id: Optional[int] = None,
+               arrival: float = 0.0) -> tuple:
+    """Re-root ONE live journal entry as a fresh request — THE failover
+    primitive shared by the single-engine supervisor (``replay_requests``)
+    and the fleet router's replica migration: the replacement request's
+    prompt embeds every delivered token with the remaining budget, so
+    chunked prefill re-ingests the concatenation and the prefill-final
+    argmax emits exactly the token the lost engine would have emitted
+    next (greedy determinism).  The re-rooting is built from the ENTRY
+    itself — ``ent.prompt`` already embeds the ``pre`` prefix of the
+    submit it records, so ``ent.prompt + ent.toks`` is correct whether
+    ``req`` is the original request OR an earlier replay's re-rooted
+    one (a fault during a journal-resumed run; building from
+    ``req.prompt + delivered`` there would double-embed the prefix).
+    ``req`` contributes only identity (id, session).
+
+    Returns ``(request, delivered)``; ``request`` is None when the
+    stream is already complete (the engine died between the final token
+    and its end record) — the caller records the terminal ``ok``.
+    Deadlines are dropped (the caller's clock decides any fresh TTL);
+    the session key survives so re-homed sticky placement still sees
+    it."""
+    done = ent.delivered
+    if eos_id is not None and eos_id in done:
+        done = done[:done.index(eos_id) + 1]
+    remaining = ent.max_new_tokens + len(ent.pre) - len(done)
+    if remaining <= 0 or (eos_id is not None and done
+                          and done[-1] == eos_id):
+        return None, done
+    # tokens generated SINCE the recorded submit (done minus its pre,
+    # after any EOS truncation above)
+    since = done[len(ent.pre):]
+    return Request(req.id, list(ent.prompt) + since, remaining,
+                   arrival=arrival, replayed=True,
+                   session=req.session), done
+
+
+# ---------------- fleet journal assembly (serving/router) ----------------
+
+def _entry_wins(a: JournalEntry, b: JournalEntry) -> bool:
+    """Whether ``a`` is the more authoritative view of one request
+    across per-replica journals: a terminal status beats a live entry
+    (terminals fire exactly once fleet-wide), else the longer delivered
+    stream wins (a migrated-to replica's entry embeds the donor's
+    delivered prefix as ``pre``, so it strictly extends it)."""
+    if (a.status is not None) != (b.status is not None):
+        return a.status is not None
+    return len(a.delivered) > len(b.delivered)
+
+
+def merge_fleet_entries(journals) -> Dict[int, tuple]:
+    """``{request id: (entry, owning journal)}`` — the authoritative
+    per-request view across a fleet's per-replica journals."""
+    best: Dict[int, tuple] = {}
+    for j in journals:
+        for rid, ent in j.entries.items():
+            cur = best.get(rid)
+            if cur is None or _entry_wins(ent, cur[0]):
+                best[rid] = (ent, j)
+    return best
+
+
+def fleet_statuses(journals) -> Dict[int, str]:
+    """Union of terminal statuses across per-replica journals (each
+    request terminates exactly once fleet-wide, so no key collides)."""
+    out: Dict[int, str] = {}
+    for j in journals:
+        out.update(j.statuses)
+    return out
+
+
+def fleet_outputs(journals) -> Dict[int, List[int]]:
+    """Delivered streams of every completed request, fleet-wide —
+    ``pre + toks`` of each request's authoritative entry, so a stream
+    split across a failover (donor prefix + survivor suffix) comes back
+    whole."""
+    return {rid: ent.delivered
+            for rid, (ent, _j) in merge_fleet_entries(journals).items()
+            if ent.status == "ok"}
+
+
+def fleet_replay_requests(journals, requests: List[Request],
+                          eos_id: Optional[int] = None) -> tuple:
+    """The request list a replacement FLEET run should serve, plus the
+    ``{request id: delivered prefix}`` map the router stages into
+    whichever replica's journal each replay lands on (per-replica
+    journals can't pre-stage it — placement isn't known until route
+    time).  Mirrors ``ReplayJournal.replay_requests`` over the merged
+    per-replica view: never-journaled requests as-is, live requests
+    re-rooted at ``prompt + delivered``, terminated requests omitted."""
+    merged = merge_fleet_entries(journals)
+    statuses = fleet_statuses(journals)
+    todo: List[Request] = []
+    pre: Dict[int, List[int]] = {}
+    for req in requests:
+        got = merged.get(req.id)
+        if got is None:
+            if req.id not in statuses:
+                todo.append(req)
+            continue
+        ent, journal = got
+        if ent.status is not None or req.id in statuses:
+            # a terminal status ANYWHERE in the fleet wins over a stale
+            # live entry in another journal (e.g. migrated off a dead
+            # donor — whose on-disk entry stays live — then shed during
+            # a drain before the survivor ever submitted it: the end
+            # record is entry-less in the survivor's journal).  Each
+            # request gets exactly ONE terminal status across runs.
+            continue
+        rep, done = replay_one(ent, req, eos_id)
+        if rep is None:
+            # crashed between the final token and its end record
+            journal.record_end(req, "ok")
+            continue
+        todo.append(rep)
+        pre[req.id] = done
+    return todo, pre
 
 
 def run_with_replay(make_engine: Callable[[], "object"],

@@ -1,47 +1,62 @@
-"""Data-parallel replica serving: a router over N decode engines.
+"""Data-parallel replica serving: a fault-tolerant router over N engines.
 
 The engine (serving/engine) scales UP with ``--serve-tp`` — one logical
 pool, sharded over a mesh.  This layer scales OUT: ``N`` whole engine
-replicas, each with its own pool, scheduler, prefix trie, and drafter,
-fronted by one router that owns placement and (with the schedulers'
-bounded queues) load-aware admission.  Together they are the Orca-style
-distributed serving shape: aggregate KV capacity and tokens/sec grow
-with replicas instead of one device's pool.
+replicas, each with its own pool, scheduler, prefix trie, drafter, and
+— since fleet fault tolerance landed — its own ``ReplayJournal``,
+fronted by one router that owns placement, health, and failover.
 
 Placement policy, in order:
 
 1. **Session affinity** — a request carrying ``Request.session`` sticks
-   to the replica that served that session before.  The payoff is
-   locality of everything a replica accumulates per conversation: radix
-   prefix-cache blocks (a follow-up turn re-hits its own prefix trie),
-   draft-model KV state, and — in a real deployment — the network hop.
-2. **Least load** — sessionless requests (and a session's first
-   request) go to the replica minimizing a load score built from the
-   scheduler's OWN health signals: waiting-queue depth (each queued
-   request is a whole admission behind), live-slot fraction, pool
-   occupancy, and observed shed rate.  No new instrumentation: these
-   are exactly the scale signals the schedulers already expose.
+   to the replica that served that session before (prefix-cache blocks,
+   draft-model KV, and — in a real deployment — the network hop stay
+   local).  The sticky map is LRU-BOUNDED: sessions with no live
+   requests are evicted past ``max_sticky`` entries (affinity is a
+   locality hint, not durable state), and a session whose replica is
+   ejected re-homes on its next request.
+2. **Health gate** — only replicas the circuit breaker calls routable
+   (``healthy`` or ``probing``) take work.
+3. **Least load** — scored from the schedulers' OWN signals: waiting-
+   queue depth (dominant), live-slot fraction, pool occupancy, shed
+   rate.
 
-Placement can never change tokens: greedy decode is deterministic per
-request, so whichever replica serves a request emits exactly the stream
-a single-engine run would (pinned by tests/test_router.py).  Placement
-changes latency, terminal statuses under pressure, and throughput.
+Failure is a first-class event, not a crash.  Each replica runs the
+SAME per-iteration body as ``engine.run`` (serving/iteration.EngineLoop
+— the shared extraction that replaced the old ``tick()`` mirror), so
+guard/journal/drain semantics exist in exactly one place.  When a tick
+raises — a real device error or an injected ``FaultPlan`` fault — the
+router classifies it with ``train/elastic.is_transient`` (status-code-
+first, same as training) and:
 
-Execution: ``run(..., parallel=True)`` drives each replica from its own
-thread — schedulers and pools are single-owner (only the replica's
-thread touches them), the router hands requests over through a locked
-inbox, and jax dispatch/blocking release the GIL so replicas' device
-work overlaps (the in-process stand-in for one-process-per-replica).
-``parallel=False`` interleaves all replicas round-robin on the calling
-thread — deterministic scheduling for tests.
+- **migrates** the replica's live + queued requests: each journal-live
+  entry is re-rooted at ``prompt + delivered`` (recovery.replay_one)
+  and re-routed to a surviving replica, where chunked prefill replays
+  the prefix token-identically — greedy outputs match an unfaulted run
+  exactly (the PR 2 determinism contract, lifted from engine to fleet);
+- **ejects** the replica: transient faults arm a capped exponential
+  backoff (base ``ServeConfig.failover_backoff_ms``, doubled per
+  consecutive fault, capped at 64x) after which the replica is rebuilt
+  (``make_engine`` factory, else ``engine.reset()``) and PROBED — it
+  takes traffic again and is readmitted after ``probe_ticks`` clean
+  iterations.  Permanent faults (a deterministic bug, OOM) mark the
+  replica DEAD: it never returns, and a fleet with every replica dead
+  re-raises the last error rather than spinning.
 
-Scope: the router serves a fixed trace to completion.  Graceful drain
-(PreemptionGuard) and journaled crash recovery remain ENGINE-level
-features — `tick()` mirrors `engine.run`'s per-iteration accounting
-(latency cadence, eviction sample-discard) but does not wire guard or
-journal through; routing those per-replica, and sharing one iteration
-body with ``engine.run`` instead of mirroring it, is the
-next extension of ROADMAP item 1.
+SIGTERM drains the WHOLE fleet: admission stops, queued work sheds,
+each replica finishes in-flight sequences within ``--serve-drain-ms``,
+and the budget's hard edge cuts the rest as ``drained`` — every request
+still leaves with exactly one terminal status, and
+``Scheduler.check_quiescent`` is asserted on every surviving replica at
+the end of ``run`` (the engine-level pool-leak invariant, fleet-wide).
+
+Execution: ``run(parallel=True)`` drives each replica from its own
+thread (single-owner scheduler state, locked inboxes, jax dispatch
+releases the GIL); ``parallel=False`` interleaves replicas round-robin
+on the calling thread — deterministic scheduling for tests.  Failover,
+probing, and drain are main-thread decisions in both modes: a worker
+that faults hands its exception to the router loop and exits; a rebuilt
+replica gets a fresh worker.
 """
 
 from __future__ import annotations
@@ -50,12 +65,18 @@ import dataclasses
 import os
 import threading
 import time
-from collections import deque
+from collections import Counter, OrderedDict, deque
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from mpi_tensorflow_tpu.serving import recovery as rec_lib
 from mpi_tensorflow_tpu.serving import scheduler as sched_lib
+from mpi_tensorflow_tpu.serving.iteration import DrainTracker, EngineLoop
+from mpi_tensorflow_tpu.train import elastic
+
+#: replica circuit-breaker states
+HEALTHY, EJECTED, PROBING, DEAD = "healthy", "ejected", "probing", "dead"
 
 
 def default_parallelism() -> bool:
@@ -72,31 +93,118 @@ def default_parallelism() -> bool:
         return (os.cpu_count() or 1) > 1
 
 
+@dataclasses.dataclass
+class ReplicaFault:
+    """One scheduled injected fault: kill replica ``replica`` when its
+    tick counter reaches ``at_step`` (1-based; deterministic under
+    ``parallel=False``).  ``kind`` picks the classification the injected
+    error carries — ``transient`` raises with an UNAVAILABLE status code
+    (eject + backoff + probe), ``permanent`` with FAILED_PRECONDITION
+    (dead forever) — so the fault flows through exactly the status-code-
+    first ``elastic.is_transient`` path a real PJRT error would."""
+    replica: int
+    at_step: int
+    kind: str = "transient"
+
+    def __post_init__(self):
+        if self.kind not in ("transient", "permanent"):
+            raise ValueError(f"fault kind must be transient|permanent, "
+                             f"got {self.kind!r}")
+        if self.at_step < 1 or self.replica < 0:
+            raise ValueError(f"bad fault plan entry: {self}")
+
+
+class FaultPlan:
+    """The replica fault-injection seam: a list of ``ReplicaFault``
+    entries checked at the TOP of every replica tick (before the inbox
+    snapshot, so queued handoffs are never half-consumed).  Each entry
+    fires at most once; ``fired`` records what actually went off."""
+
+    def __init__(self, faults: List[ReplicaFault]):
+        self.faults = list(faults)
+        self.fired: List[ReplicaFault] = []
+
+    def check(self, replica: int, step: int) -> None:
+        for f in list(self.faults):
+            if f.replica == replica and step >= f.at_step:
+                self.faults.remove(f)
+                self.fired.append(f)
+                code = ("UNAVAILABLE" if f.kind == "transient"
+                        else "FAILED_PRECONDITION")
+                raise RuntimeError(
+                    f"{code}: injected replica fault (FaultPlan: "
+                    f"replica {replica} at tick {step}, {f.kind})")
+
+
+@dataclasses.dataclass
+class ReplicaHealth:
+    """Circuit-breaker state of one replica."""
+    state: str = HEALTHY
+    faults: int = 0               # consecutive transient faults (reset
+                                  # when a probe readmits the replica)
+    backoff_s: float = 0.0        # current probe backoff
+    retry_at: float = 0.0         # run-clock stamp when a probe may run
+    probe_ticks: int = 0          # clean ticks since the probe started
+
+
 class ReplicaRouter:
-    """Route requests across engine replicas; aggregate their results.
+    """Route requests across engine replicas; survive replica failure.
 
     ``engines``: fully constructed ``PagedDecodeEngine`` replicas (they
     may share model/params arrays — each still owns its pools and jit
-    caches).  ``reset()`` resets every replica (jit caches survive,
-    mirroring ``engine.reset``) and forgets session placements.
+    caches).  ``make_engine``: optional zero-arg factory used to rebuild
+    an ejected replica at probe time (real device loss needs fresh
+    pools); without it the probe calls ``engine.reset()`` — fresh
+    host/pool state, warmed jit caches kept, which is exactly right for
+    in-process faults and keeps the zero-recompile contract intact.
+    ``probe_ticks``: clean iterations a probing replica must complete
+    before readmission.  ``max_sticky``: LRU bound on the session
+    affinity map (entries for sessions with live requests are never
+    evicted).  ``reset()`` resets every replica AND the health/affinity
+    state — a fresh fleet for a fresh trace replay.
     """
 
-    def __init__(self, engines: List):
+    def __init__(self, engines: List, *, make_engine=None,
+                 probe_ticks: int = 4, max_sticky: int = 1024):
         if not engines:
             raise ValueError("ReplicaRouter needs >= 1 engine replica")
+        if probe_ticks < 1 or max_sticky < 1:
+            raise ValueError(f"bad router policy: probe_ticks "
+                             f"{probe_ticks} (>= 1), max_sticky "
+                             f"{max_sticky} (>= 1)")
         self.engines = list(engines)
-        self._sticky: Dict[object, int] = {}    # session -> replica
-        self.placements: Dict[int, int] = {}    # request id -> replica
-        self._routed = [0] * len(self.engines)
+        self.make_engine = make_engine
+        self.probe_ticks = probe_ticks
+        self.max_sticky = max_sticky
+        base = engines[0].serve.failover_backoff_ms / 1e3
+        self.backoff_base_s = base
+        self.backoff_cap_s = base * 64
+        self._lock = threading.Lock()
+        self._running = False
+        self._cold_state()
+
+    def _cold_state(self) -> None:
+        """Fresh fleet state (construction + ``reset``)."""
+        n = len(self.engines)
+        self._sticky: OrderedDict = OrderedDict()   # session -> replica
+        self._session_live: Counter = Counter()     # session -> live reqs
+        self.placements: Dict[int, int] = {}        # request id -> replica
+        self._routed = [0] * n
+        self.health = [ReplicaHealth() for _ in range(n)]
+        self.fleet_counters: Counter = Counter()
+        self._last_error: Optional[BaseException] = None
 
     def reset(self) -> None:
         for eng in self.engines:
             eng.reset()
-        self._sticky.clear()
-        self.placements.clear()
-        self._routed = [0] * len(self.engines)
+        self._cold_state()
 
     # ---------------- placement ----------------
+
+    def routable(self) -> List[int]:
+        """Replica indices the health gate admits traffic to."""
+        return [i for i, h in enumerate(self.health)
+                if h.state in (HEALTHY, PROBING)]
 
     def load_score(self, i: int, inbox_depth: int = 0) -> float:
         """One replica's load, from its scheduler's own signals.  Queue
@@ -115,198 +223,550 @@ class ReplicaRouter:
                 + shed_rate * 0.2)
 
     def route(self, req: sched_lib.Request,
-              inbox_depths: Optional[List[int]] = None) -> int:
-        """Pick the replica for ``req``: sticky session first, else
-        least-loaded (ties break to the lowest index, so an idle fleet
-        fills deterministically)."""
+              inbox_depths: Optional[List[int]] = None) -> Optional[int]:
+        """Pick the replica for ``req``: sticky session first (health-
+        gated — an ejected home re-homes the session), else least-loaded
+        among routable replicas (ties break to the lowest index, so an
+        idle fleet fills deterministically).  None = nothing routable
+        right now (every replica ejected/dead; the caller holds the
+        request until a probe readmits one)."""
+        ok = self.routable()
+        if not ok:
+            return None
         key = req.session
-        i = self._sticky.get(key) if key is not None else None
+        i = None
+        if key is not None:
+            # read + health-check + LRU-touch under ONE lock hold: the
+            # worker-side terminal hook trims this map concurrently, so
+            # a get outside the lock could name a key the trim evicts
+            # before the touch
+            with self._lock:
+                i = self._sticky.get(key)
+                if i is not None and self.health[i].state \
+                        not in (HEALTHY, PROBING):
+                    # stale affinity to an ejected/dead replica (it
+                    # re-armed after the failover sweep): re-home now
+                    self._sticky.pop(key, None)
+                    self.fleet_counters["sticky_rehomed"] += 1
+                    i = None
+                elif i is not None:
+                    self._sticky.move_to_end(key)   # LRU touch
         if i is None:
             depths = inbox_depths or [0] * len(self.engines)
-            i = min(range(len(self.engines)),
-                    key=lambda j: (self.load_score(j, depths[j]), j))
+            i = min(ok, key=lambda j: (self.load_score(j, depths[j]), j))
             if key is not None:
-                self._sticky[key] = i
+                with self._lock:
+                    self._sticky[key] = i
+                    self._sticky.move_to_end(key)
+        with self._lock:
+            if key is not None and req.id not in self.placements:
+                # first placement of this request pins its session live
+                # (a MIGRATED request re-routes without re-pinning — its
+                # one terminal notification un-pins exactly once)
+                self._session_live[key] += 1
         self._routed[i] += 1
         self.placements[req.id] = i
         return i
+
+    # ---------------- terminal / sticky bookkeeping ----------------
+
+    def _notify_terminal(self, i: int, req, status: str) -> None:
+        """Chained behind each adopted engine's own terminal hook: one
+        call per request fleet-wide (terminals fire exactly once)."""
+        if not self._running:
+            return
+        with self._lock:
+            self._outstanding.discard(req.id)
+            if self._drain.draining:
+                self._drain_counts[status] += 1
+            s = req.session
+            if s is not None and s in self._session_live:
+                self._session_live[s] -= 1
+                if self._session_live[s] <= 0:
+                    del self._session_live[s]
+            self._trim_sticky_locked()
+
+    def _trim_sticky_locked(self) -> None:
+        """Bound the affinity map: evict LRU sessions with no live
+        requests once past ``max_sticky`` — terminal requests must not
+        pin map entries forever (the map is a locality hint; an evicted
+        session simply re-places by load on its next request)."""
+        if len(self._sticky) <= self.max_sticky:
+            return
+        for k in list(self._sticky):
+            if len(self._sticky) <= self.max_sticky:
+                break
+            if k not in self._session_live:
+                del self._sticky[k]
+                self.fleet_counters["sticky_evicted"] += 1
+
+    def stats(self) -> dict:
+        """Router health/affinity accounting (the fleet_faults block
+        plus the sticky-map hygiene counters)."""
+        from mpi_tensorflow_tpu.utils.metrics_writer import \
+            fleet_faults_block
+
+        return {
+            "sticky_sessions": len(self._sticky),
+            "sticky_live_sessions": len(self._session_live),
+            "sticky_capacity": self.max_sticky,
+            "sticky_rehomed": int(self.fleet_counters["sticky_rehomed"]),
+            "sticky_evicted": int(self.fleet_counters["sticky_evicted"]),
+            "health": [dataclasses.asdict(h) for h in self.health],
+            "fleet_faults": fleet_faults_block(self.fleet_counters),
+        }
+
+    # ---------------- replica binding / failover ----------------
+
+    def _bind(self, i: int, engine) -> None:
+        """Adopt ``engine`` as replica ``i``: fresh iteration loop bound
+        to the replica's journal, terminal hook chained to the router's
+        bookkeeping (the engine's own hook — drafter release + journal
+        record_end — still runs first, preserving tok-then-end order)."""
+        self.engines[i] = engine
+
+        def hook(req, status, _i=i, _fn=engine._on_terminal):
+            _fn(req, status)
+            self._notify_terminal(_i, req, status)
+
+        engine.sched.on_terminal = hook
+        self._loops[i] = EngineLoop(engine, self._journals[i])
+
+    def _failover(self, i: int, exc: BaseException, now: float) -> None:
+        """Replica ``i`` failed: archive its accounting, eject it
+        (backoff or dead), re-home its sticky sessions, and migrate its
+        live + queued requests to the router's pending list — each
+        journal-live entry re-rooted at ``prompt + delivered`` so a
+        surviving replica replays it token-identically through chunked
+        prefill."""
+        self._last_error = exc
+        transient = elastic.is_transient(exc)
+        h = self.health[i]
+        eng = self.engines[i]
+        print(f"[serving-router] replica {i} "
+              f"{'transient' if transient else 'PERMANENT'} fault "
+              f"({exc!r}); migrating its work")
+        # archive the dead incarnation's accounting: latency samples of
+        # already-delivered tokens stay valid (the client keeps that
+        # prefix — replay regenerates only what follows), and its fault
+        # counters must survive the rebuild
+        loop = self._loops[i]
+        if loop is not None:
+            self._lat_archive[i].extend(loop.latencies())
+            self._tokens_archive[i] += loop.tokens
+            self._peak_queue[i] = max(self._peak_queue[i],
+                                      loop.peak_queue)
+            self._counter_snap[i].update(eng.sched.counters)
+            self._evict_snap[i] += eng.sched.evictions
+        self._loops[i] = None
+        with self._lock:
+            self.fleet_counters["failovers"] += 1
+            self.fleet_counters["ejections"] += 1
+            stale = [k for k, v in self._sticky.items() if v == i]
+            for k in stale:
+                del self._sticky[k]
+            self.fleet_counters["sticky_rehomed"] += len(stale)
+        if transient:
+            h.faults += 1
+            h.backoff_s = min(self.backoff_cap_s,
+                              self.backoff_base_s * 2 ** (h.faults - 1))
+            h.retry_at = now + h.backoff_s
+            h.state = EJECTED
+        else:
+            h.state = DEAD
+        # migration set: requests handed over but not yet submitted
+        # (inbox) ride as-is; journal-live requests re-root at
+        # prompt + delivered.  Both re-enter the router's pending list
+        # due immediately and re-route on the next loop pass.
+        with self._inbox_locks[i]:
+            moved = list(self._inboxes[i])
+            self._inboxes[i].clear()
+        journal = self._journals[i]
+        eos = eng.serve.eos_id
+        with self._lock:
+            live = [rid for rid, ent in journal.entries.items()
+                    if ent.status is None and rid in self._outstanding]
+        for rid in sorted(live):
+            req = self._requests_by_id.get(rid)
+            if req is None:
+                continue
+            rep, done = rec_lib.replay_one(journal.entries[rid], req,
+                                           eos, arrival=now)
+            if rep is None:
+                # died between the final token and its end record: the
+                # entry is complete — terminate it in place (it stays
+                # in the journal as the request's output stream)
+                journal.record_end(req, "ok")
+                self._notify_terminal(i, req, "ok")
+                continue
+            # the donor's in-memory live entry is now STALE — the
+            # request's authoritative stream continues wherever the
+            # replay lands.  Drop it, or a readmitted donor faulting a
+            # SECOND time would re-migrate a request still live on a
+            # survivor (duplicate serving; worse, the duplicate's
+            # record_submit would overwrite the live entry and void its
+            # tokens).  In-memory only: the on-disk record stays, and a
+            # full-process crash reload resolves it through the merge
+            # (terminal status wins, else longest delivered).
+            journal.entries.pop(rid, None)
+            self._pre[rid] = done
+            self.fleet_counters["replay_tokens"] += len(rep.prompt)
+            moved.append(rep)
+        self.fleet_counters["migrated_requests"] += len(moved)
+        if moved:
+            self._pending = sorted(self._pending + moved,
+                                   key=lambda r: r.arrival)
+
+    def _maybe_probe(self, now: float) -> List[int]:
+        """Rebuild ejected replicas whose backoff has elapsed and mark
+        them PROBING (they take traffic again; ``probe_ticks`` clean
+        iterations readmit them).  Returns the replica indices revived
+        this call — the parallel loop starts a fresh worker for each."""
+        revived = []
+        for i, h in enumerate(self.health):
+            if h.state != EJECTED or now < h.retry_at:
+                continue
+            if self.make_engine is not None:
+                eng = self.make_engine()
+            else:
+                eng = self.engines[i]
+                eng.reset()     # fresh pools/scheduler, warm jit caches
+            self._bind(i, eng)
+            h.state = PROBING
+            h.probe_ticks = 0
+            revived.append(i)
+        return revived
+
+    # ---------------- the per-replica tick ----------------
+
+    def _tick(self, i: int, time_fn, t0: float) -> bool:
+        """One iteration for replica ``i`` — the SHARED engine body
+        (serving/iteration.EngineLoop) plus the router's handoff/drain/
+        probe edges.  Returns whether any work moved.  Only replica
+        ``i``'s thread (or the sequential caller) runs this —
+        scheduler/pool state stays single-owner."""
+        self._ticks[i] += 1
+        if self._fault_plan is not None:
+            # the injection seam fires BEFORE the inbox snapshot so a
+            # handoff is never half-consumed by a dying replica
+            self._fault_plan.check(i, self._ticks[i])
+        eng = self.engines[i]
+        loop = self._loops[i]
+        with self._inbox_locks[i]:
+            todo = list(self._inboxes[i])
+            self._inboxes[i].clear()
+        draining = self._drain.draining
+        if draining and not self._drain_shed_done[i]:
+            # fleet drain: this replica sheds its waiting queue once;
+            # in-flight sequences keep running inside the budget
+            self._drain_shed_done[i] = True
+            eng.sched.shed_waiting()
+        if self._abort_req[i] and not self._abort_done[i]:
+            # the drain budget's hard edge
+            self._abort_done[i] = True
+            eng.sched.abort_live("drained")
+        now = time_fn() - t0
+        for req in todo:
+            if draining:
+                eng.sched.fail_request(req, "shed")
+                continue
+            # a migrated/replayed request re-admits AT THE FRONT (it
+            # already waited its turn once) with its delivered prefix
+            # staged into this replica's journal
+            loop.submit(req, pre=self._pre.pop(req.id, None),
+                        front=req.replayed)
+        emitted = loop.iterate(now, time_fn, t0)
+        h = self.health[i]
+        if h.state == PROBING:
+            h.probe_ticks += 1
+            if h.probe_ticks >= self.probe_ticks:
+                # readmitted: the fault streak is broken, so the
+                # "consecutive faults" backoff restarts at base — an
+                # isolated fault hours later must not pay an escalated
+                # penalty (flapping replicas re-escalate fast anyway:
+                # a fault during PROBING never reaches this reset)
+                h.state = HEALTHY
+                h.faults = 0
+                h.backoff_s = 0.0
+                with self._lock:
+                    self.fleet_counters["readmissions"] += 1
+        return bool(todo) or bool(emitted) or eng._progressed
 
     # ---------------- the serve loop ----------------
 
     def run(self, requests: List[sched_lib.Request],
             time_fn=time.perf_counter, *,
-            parallel: Optional[bool] = None) -> dict:
+            parallel: Optional[bool] = None, guard=None,
+            journals: Optional[List] = None,
+            replay_pre: Optional[Dict[int, List[int]]] = None,
+            fault_plan: Optional[FaultPlan] = None) -> dict:
         """Serve ``requests`` (replayed against their ``arrival``
-        stamps) across the replicas to completion.  Latency semantics
-        match ``engine.run`` (per-token cadence, eviction discards);
-        the result adds a per-replica metrics list (queue depth, pool
-        occupancy, shed rate, tokens/sec — the acceptance signals) next
-        to the aggregated outputs/statuses/faults.
+        stamps) across the replicas to completion, failing over replica
+        faults.  Latency semantics match ``engine.run`` (the SHARED
+        iteration body guarantees it); the result adds per-replica
+        metrics, the fleet drain outcome, and the ``fleet_faults``
+        block.
 
         ``parallel``: None (default) auto-selects — threads when the
         host has >1 usable core (``default_parallelism``), sequential
-        round-robin otherwise; True/False force a mode."""
+        round-robin otherwise; True/False force a mode.  ``guard``
+        wires SIGTERM to a fleet-wide graceful drain.  ``journals``:
+        one ``ReplayJournal`` per replica (pre-loaded journals resume a
+        crashed fleet — pair with ``recovery.fleet_replay_requests``
+        and pass its ``pre`` map as ``replay_pre``); None = fresh
+        memory-only journals, which is what arms in-process failover.
+        ``fault_plan`` injects deterministic replica faults (tests/
+        bench)."""
         if parallel is None:
             parallel = default_parallelism()
         n = len(self.engines)
-        pending = sorted(requests, key=lambda r: r.arrival)
-        inboxes = [deque() for _ in range(n)]
-        locks = [threading.Lock() for _ in range(n)]
-        token_times: List[dict] = [dict() for _ in range(n)]
-        last_emit: List[dict] = [dict() for _ in range(n)]
-        tokens_count = [0] * n
-        peak_queue = [0] * n
-        routing_done = threading.Event()
-        errors: List[BaseException] = []
+        if journals is not None and len(journals) != n:
+            raise ValueError(f"need one journal per replica: got "
+                             f"{len(journals)} for {n} replicas")
+        self._journals = (list(journals) if journals is not None
+                          else [rec_lib.ReplayJournal()
+                                for _ in range(n)])
+        self._fault_plan = fault_plan
+        self._pre = dict(replay_pre or {})
+        self._requests_by_id = {r.id: r for r in requests}
+        self._outstanding = set(self._requests_by_id)
+        self._pending = sorted(requests, key=lambda r: r.arrival)
+        self._inboxes = [deque() for _ in range(n)]
+        self._inbox_locks = [threading.Lock() for _ in range(n)]
+        self._ticks = [0] * n
+        self._loops: List[Optional[EngineLoop]] = [None] * n
+        self._lat_archive: List[List[float]] = [[] for _ in range(n)]
+        self._tokens_archive = [0] * n
+        self._peak_queue = [0] * n
+        self._counter_snap = [Counter() for _ in range(n)]
+        self._evict_snap = [0] * n
+        self._drain = DrainTracker(self.engines[0].serve.drain_ms)
+        self._drain_counts: Counter = Counter()
+        self._drain_shed_done = [False] * n
+        self._abort_req = [False] * n
+        self._abort_done = [False] * n
+        for i, h in enumerate(self.health):
+            if h.state == EJECTED:
+                # stamps from a previous run's clock are stale; re-arm
+                # the backoff from this run's zero
+                h.retry_at = h.backoff_s
+            if h.state in (HEALTHY, PROBING):
+                self._bind(i, self.engines[i])
+        self._running = True
         t0 = time_fn()
+        try:
+            if parallel:
+                self._run_parallel(time_fn, t0, guard)
+            else:
+                self._run_sequential(time_fn, t0, guard)
+            elapsed = time_fn() - t0
+            return self._aggregate(parallel, elapsed)
+        finally:
+            self._running = False
+            for i, eng in enumerate(self.engines):
+                if self._loops[i] is not None:
+                    # un-chain the router hook: a later engine.run on
+                    # this engine must not touch dead run state
+                    eng.sched.on_terminal = eng._on_terminal
 
-        def route_due(now: float) -> None:
-            while pending and pending[0].arrival <= now:
-                req = pending.pop(0)
-                depths = [len(b) for b in inboxes]
-                i = self.route(req, depths)
-                with locks[i]:
-                    inboxes[i].append(req)
+    def _route_due(self, now: float, all_due: bool = False) -> None:
+        while self._pending and (all_due
+                                 or self._pending[0].arrival <= now):
+            depths = [len(b) for b in self._inboxes]
+            i = self.route(self._pending[0], depths)
+            if i is None:
+                return              # nothing routable; hold the queue
+            req = self._pending.pop(0)
+            with self._inbox_locks[i]:
+                self._inboxes[i].append(req)
 
-        def tick(i: int) -> bool:
-            """One engine iteration for replica ``i`` (same shape as
-            the body of ``engine.run``'s loop).  Returns whether any
-            work moved.  Only replica ``i``'s thread (or the sequential
-            caller) runs this — scheduler/pool state is single-owner."""
-            eng = self.engines[i]
-            with locks[i]:
-                todo = list(inboxes[i])
-                inboxes[i].clear()
+    def _drain_edges(self, now: float, guard) -> None:
+        """Fleet drain state machine, run from the main loop: SIGTERM
+        stops admission and pushes everything queued at the router to
+        the replicas (whose draining ticks shed it — one terminal per
+        request through the normal scheduler/journal path); the budget's
+        hard edge arms per-replica abort."""
+        if guard is not None and guard.should_stop \
+                and not self._drain.draining:
+            self._drain.start(now)
+            self._route_due(now, all_due=True)
+            for req in self._pending:   # nothing routable: shed direct
+                self._terminal_direct(req, "shed")
+            self._pending = []
+        if self._drain.expired(now) and not all(self._abort_req):
+            self._abort_req = [True] * len(self.engines)
+            for req in self._pending:
+                self._terminal_direct(req, "shed")
+            self._pending = []
+
+    def _terminal_direct(self, req, status: str) -> None:
+        """Terminal for a request no routable replica can shed (every
+        replica ejected/dead at drain time): record straight into
+        journal 0 so the fleet status/outstanding accounting stays
+        exact."""
+        self._journals[0].record_end(req, status)
+        self._notify_terminal(0, req, status)
+
+    def _fleet_dead(self) -> bool:
+        """True when no replica can ever serve again (all DEAD)."""
+        return all(h.state == DEAD for h in self.health)
+
+    def _run_sequential(self, time_fn, t0, guard) -> None:
+        while True:
             now = time_fn() - t0
-            for req in todo:
-                if eng.serve.deadline_ms is not None \
-                        and req.deadline is None:
-                    req = dataclasses.replace(
-                        req,
-                        deadline=req.arrival + eng.serve.deadline_ms / 1e3)
-                if eng.sched.submit(req) is not None:
-                    continue        # terminal status recorded on replica
-                last_emit[i][req.id] = req.arrival
-                token_times[i][req.id] = []
-            peak_queue[i] = max(peak_queue[i], len(eng.sched.waiting))
-            eng.sched.expire_deadlines(now)
-            emitted = eng.step()
-            now = time_fn() - t0
-            for rid, tok in emitted:
-                if rid in last_emit[i]:
-                    token_times[i][rid].append(now - last_emit[i][rid])
-                    last_emit[i][rid] = now
-            tokens_count[i] += len(emitted)
-            for rid in eng.sched.evicted_ids:
-                # eviction discards the delivered-so-far latency sample,
-                # exactly as engine.run does
-                token_times[i][rid] = []
-                last_emit[i][rid] = now
-            eng.sched.evicted_ids.clear()
-            return bool(todo) or bool(emitted) or eng._progressed
-
-        if parallel:
-            def worker(i: int) -> None:
+            self._drain_edges(now, guard)
+            self._maybe_probe(now)
+            self._route_due(now)
+            progressed = False
+            for i in list(self.routable()):
                 try:
-                    while True:
-                        progressed = tick(i)
-                        if not progressed:
-                            # observe routing_done BEFORE the inbox
-                            # snapshot: once the flag is set no append
-                            # can follow, so flag-then-empty is
-                            # conclusive — the reverse order races a
-                            # final route landing between the snapshot
-                            # and the flag read, silently dropping it
-                            done_routing = routing_done.is_set()
-                            with locks[i]:
-                                empty = not inboxes[i]
-                            if done_routing and empty \
-                                    and self.engines[i].sched.all_done():
+                    progressed = self._tick(i, time_fn, t0) or progressed
+                except Exception as e:  # noqa: BLE001 — classified in
+                    self._failover(i, e, time_fn() - t0)   # _failover
+                    progressed = True
+            with self._lock:
+                done = not self._outstanding
+            if done:
+                return
+            if not self.routable():
+                if self._fleet_dead():
+                    raise self._last_error
+                progressed = False      # every replica in backoff: wait
+            if not progressed:
+                delay = 1e-3
+                if self._pending and self.routable():
+                    # clamp to the next arrival ONLY while someone can
+                    # take it — with the whole fleet in backoff an
+                    # overdue arrival would clamp the delay to zero and
+                    # busy-spin the core for the entire backoff window
+                    delay = min(delay, max(
+                        0.0,
+                        self._pending[0].arrival - (time_fn() - t0)))
+                if delay > 0:
+                    time.sleep(delay)
+
+    def _run_parallel(self, time_fn, t0, guard) -> None:
+        stop = threading.Event()
+        failures: List[tuple] = []
+        threads: Dict[int, threading.Thread] = {}
+
+        def worker(i: int) -> None:
+            try:
+                while True:
+                    progressed = self._tick(i, time_fn, t0)
+                    if not progressed:
+                        if stop.is_set():
+                            with self._inbox_locks[i]:
+                                empty = not self._inboxes[i]
+                            if empty and self.engines[i].sched.all_done():
                                 return
-                            time.sleep(1e-3)
-                except BaseException as e:   # noqa: BLE001 — re-raised
-                    errors.append(e)         # in the router thread below
+                        time.sleep(1e-3)
+            except BaseException as e:   # noqa: BLE001 — handed to the
+                with self._lock:         # router loop for failover
+                    failures.append((i, e))
 
-            threads = [threading.Thread(target=worker, args=(i,),
-                                        name=f"serve-replica-{i}",
-                                        daemon=True) for i in range(n)]
-            for t in threads:
-                t.start()
-            while pending and not errors:
+        def start(i: int) -> None:
+            t = threading.Thread(target=worker, args=(i,),
+                                 name=f"serve-replica-{i}", daemon=True)
+            threads[i] = t
+            t.start()
+
+        for i in self.routable():
+            start(i)
+        try:
+            while True:
                 now = time_fn() - t0
-                route_due(now)
-                if pending:
-                    time.sleep(min(1e-3, max(
-                        0.0, pending[0].arrival - (time_fn() - t0))))
-            routing_done.set()
-            for t in threads:
+                with self._lock:
+                    fails, failures[:] = list(failures), []
+                for i, e in fails:
+                    t = threads.pop(i, None)
+                    if t is not None:
+                        t.join()        # the worker exits on fault
+                    self._failover(i, e, time_fn() - t0)
+                self._drain_edges(now, guard)
+                for i in self._maybe_probe(now):
+                    start(i)
+                self._route_due(now)
+                with self._lock:
+                    done = not self._outstanding
+                if done:
+                    return
+                if not self.routable() and self._fleet_dead():
+                    raise self._last_error
+                time.sleep(1e-3)
+        finally:
+            stop.set()
+            for t in threads.values():
                 t.join()
-            if errors:
-                raise errors[0]
-        else:
-            routing_done.set()      # sequential: routing happens inline
-            while pending or not all(e.sched.all_done()
-                                     for e in self.engines):
-                now = time_fn() - t0
-                route_due(now)
-                progressed = False
-                for i in range(n):
-                    progressed = tick(i) or progressed
-                if not progressed:
-                    delay = 1e-3
-                    if pending:
-                        delay = min(delay, max(
-                            0.0, pending[0].arrival - (time_fn() - t0)))
-                    if delay > 0:
-                        time.sleep(delay)
-        elapsed = time_fn() - t0
 
-        # ---------------- aggregation ----------------
-        from collections import Counter
+    # ---------------- aggregation ----------------
 
-        from mpi_tensorflow_tpu.utils.metrics_writer import faults_block
+    def _aggregate(self, parallel: bool, elapsed: float) -> dict:
+        from mpi_tensorflow_tpu.utils.metrics_writer import (
+            faults_block, fleet_faults_block)
 
-        outputs: dict = {}
-        statuses: dict = {}
         totals: Counter = Counter()
         per_replica = []
+        flat: List[float] = []
         for i, eng in enumerate(self.engines):
-            eng.sched.check_quiescent()
-            if eng.drafter is not None:
-                eng.drafter.check_quiescent()
-            for s in eng.sched.finished:
-                outputs[s.request.id] = list(s.generated)
-            statuses.update(eng.sched.statuses)
-            totals.update(eng.sched.counters)
+            live = self._loops[i] is not None
+            cnts = Counter(self._counter_snap[i])
+            tokens_i = self._tokens_archive[i]
+            lats = list(self._lat_archive[i])
+            evictions = self._evict_snap[i]
+            peak_q = self._peak_queue[i]
+            if live:
+                # fleet-wide pool-leak invariant: every surviving
+                # replica must be quiescent, failover or not (the
+                # engine-level check, asserted per replica)
+                eng.sched.check_quiescent()
+                if eng.drafter is not None:
+                    eng.drafter.check_quiescent()
+                cnts.update(eng.sched.counters)
+                tokens_i += self._loops[i].tokens
+                lats += self._loops[i].latencies()
+                evictions += eng.sched.evictions
+                peak_q = max(peak_q, self._loops[i].peak_queue)
+            totals.update(cnts)
+            flat += lats
             routed = self._routed[i]
-            shed = int(eng.sched.counters.get("shed", 0))
+            shed = int(cnts.get("shed", 0))
             per_replica.append({
                 "replica": i,
+                "health": self.health[i].state,
+                "transient_faults": self.health[i].faults,
                 "requests_routed": routed,
-                "tokens": tokens_count[i],
-                "tokens_per_sec": (tokens_count[i] / elapsed
+                "tokens": tokens_i,
+                "tokens_per_sec": (tokens_i / elapsed
                                    if elapsed > 0 else 0.0),
-                "queue_depth_peak": peak_queue[i],
+                "queue_depth_peak": peak_q,
                 "pool_occupancy_peak": round(
                     eng.peak_blocks_in_use
                     / max(1, eng.serve.num_blocks - 1), 4),
                 "peak_live_blocks": eng.peak_live_blocks,
                 "shed": shed,
                 "shed_rate": round(shed / max(1, routed), 4),
-                "evictions": eng.sched.evictions,
-                "faults": faults_block(eng.sched.counters),
+                "evictions": evictions,
+                "faults": faults_block(cnts),
             })
-        flat = [x for per in token_times for ts in per.values()
-                for x in ts]
+        # outputs/statuses come from the per-replica journals — the one
+        # view that stays whole across failover (a migrated stream is
+        # donor prefix + survivor suffix) and across process restarts
+        outputs = rec_lib.fleet_outputs(self._journals)
+        statuses = rec_lib.fleet_statuses(self._journals)
         lat = np.asarray(flat) if flat else np.zeros(1)
         total = sum(len(v) for v in outputs.values())
+        drain = self._drain.result_counts(self._drain_counts)
         return {
             "parallel": parallel,
             "outputs": outputs,
             "statuses": statuses,
             "faults": faults_block(totals),
+            "fleet_faults": fleet_faults_block(self.fleet_counters),
+            "drain": drain,
+            "health": [h.state for h in self.health],
             "replicas": per_replica,
-            "num_replicas": n,
+            "num_replicas": len(self.engines),
             "sticky_sessions": len(self._sticky),
             "placements": dict(self.placements),
             "tokens": total,

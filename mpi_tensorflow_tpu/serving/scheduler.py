@@ -201,11 +201,17 @@ class Scheduler:
 
     # ---------------- queue / admission ----------------
 
-    def submit(self, req: Request) -> Optional[RejectedRequest]:
+    def submit(self, req: Request,
+               front: bool = False) -> Optional[RejectedRequest]:
         """Feasibility-checked admission to the waiting queue.  Returns
         None on accept, a structured ``RejectedRequest`` otherwise — an
         infeasible or malformed request terminates with a status; it
-        never raises into (and never crashes) the engine."""
+        never raises into (and never crashes) the engine.
+
+        ``front`` queues ahead of already-waiting work: a request
+        migrated off a failed replica (or replayed after a crash)
+        already waited its turn once — arriving behind this replica's
+        newer arrivals would double-charge it the queueing delay."""
         if not req.prompt or req.max_new_tokens < 1:
             return self._reject(req, "bad_request", "rejected")
         total = len(req.prompt) + req.max_new_tokens
@@ -223,7 +229,10 @@ class Scheduler:
             # Replayed requests are exempt: shedding recovered work
             # would orphan its already-delivered prefix
             return self._reject(req, "queue_full", "shed")
-        self.waiting.append(req)
+        if front:
+            self.waiting.appendleft(req)
+        else:
+            self.waiting.append(req)
         return None
 
     def _reject(self, req: Request, reason: str,

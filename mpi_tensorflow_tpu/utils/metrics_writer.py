@@ -100,6 +100,26 @@ def faults_block(counters) -> dict:
     return {k: int(counters.get(k, 0)) for k in SERVING_FAULT_KEYS}
 
 
+#: canonical fleet-level fault-tolerance counters (serving/router) —
+#: THE shape of the ``fleet_faults`` block every consumer sees (router
+#: result dicts, bench.py --serve-replicas JSON).  failovers = replica
+#: faults handled; migrated_requests = live/queued requests re-homed to
+#: survivors; replay_tokens = prompt+prefix tokens re-ingested through
+#: chunked prefill to reconstruct migrated streams; ejections /
+#: readmissions = circuit-breaker transitions; sticky_rehomed /
+#: sticky_evicted = session-affinity map hygiene.
+FLEET_FAULT_KEYS = ("failovers", "migrated_requests", "replay_tokens",
+                    "ejections", "readmissions", "sticky_rehomed",
+                    "sticky_evicted")
+
+
+def fleet_faults_block(counters) -> dict:
+    """Normalize a router counter mapping into the canonical
+    ``fleet_faults`` block: every key present (0 when the counter never
+    fired), values plain ints — same discipline as ``faults_block``."""
+    return {k: int(counters.get(k, 0)) for k in FLEET_FAULT_KEYS}
+
+
 def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0) -> dict:
     """Normalize scheduler/supervisor counters into the canonical
     serving ``prefix`` (radix prefix cache) accounting block — one

@@ -32,9 +32,22 @@
 # the per-run budget is tunable: T1_BUDGET=<seconds> (default 870, the
 # ROADMAP command's cap) applies to each of the two runs.
 #
+# Targeted reruns: T1_FILES is a space-separated allowlist of test
+# files; when set (and no positional args are given) the guard runs
+# exactly those files instead of the whole tier-1 sweep — the fast way
+# to re-verify a specific area (e.g. the fleet fault tests) with the
+# same truncation merge and cache hygiene as the full run.
+# T1_CACHE_OFF=1 additionally applies the MPI_TPU_DISABLE_COMPILE_CACHE
+# kill switch to the FIRST run too (not just the rerun): the right mode
+# for subprocess-heavy fault-injection files, whose child processes are
+# exactly the cross-process AOT-reload victims the cache poisoning
+# bites.
+#
 # Usage: scripts/t1_guard.sh            # the ROADMAP tier-1 invocation
 #        scripts/t1_guard.sh tests/ -m 'not slow'   # custom args
 #        T1_BUDGET=1200 scripts/t1_guard.sh         # grown suite
+#        T1_FILES="tests/test_router.py tests/test_fault_injection.py" \
+#            T1_CACHE_OFF=1 scripts/t1_guard.sh     # targeted, cache off
 
 set -u
 cd "$(dirname "$0")/.."
@@ -54,11 +67,19 @@ fi
 
 PYTEST_ARGS=("$@")
 if [ ${#PYTEST_ARGS[@]} -eq 0 ]; then
-    PYTEST_ARGS=(tests/ -m 'not slow')
+    if [ -n "${T1_FILES:-}" ]; then
+        # shellcheck disable=SC2206 — word splitting is the contract
+        PYTEST_ARGS=(${T1_FILES} -m 'not slow')
+    else
+        PYTEST_ARGS=(tests/ -m 'not slow')
+    fi
 fi
 COMMON=(-q --continue-on-collection-errors -p no:cacheprovider
         -p no:xdist -p no:randomly)
 RUN_ENV=(env JAX_PLATFORMS=cpu)
+if [ "${T1_CACHE_OFF:-0}" = "1" ]; then
+    RUN_ENV+=(MPI_TPU_DISABLE_COMPILE_CACHE=1)
+fi
 LOG1=/tmp/_t1_guard_run1.log
 LOG2=/tmp/_t1_guard_run2.log
 COLLECT=/tmp/_t1_guard_collect.txt

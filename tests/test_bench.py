@@ -487,6 +487,76 @@ class TestMeasureServing:
             bench.measure_serving(num_requests=2, tiny=True,
                                   speculative="ngram", draft_k=0)
 
+    def test_serving_fleet_journal_mode(self, tmp_path):
+        """--serve-replicas + --serve-journal (the combination PR 6
+        forbade) is now the fault-tolerant fleet serve mode: one
+        journal per replica at <path>.r<i>, outputs/statuses merged
+        across them, fleet_faults block present and clean."""
+        journal = str(tmp_path / "fleet.jsonl")
+        r = bench.measure_serving(num_requests=3, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=6,
+                                  precision="fp32", tiny=True,
+                                  journal=journal, replicas=2)
+        assert r["serve_replicas"] == 2 and r["journal"] == journal
+        assert set(r["statuses"].values()) == {"ok"}
+        assert len(r["outputs"]) == 3
+        import os
+
+        for i in range(2):
+            assert os.path.exists(f"{journal}.r{i}"), \
+                "per-replica journal file missing"
+        ff = r["fleet_faults"]
+        assert ff["failovers"] == 0 and ff["migrated_requests"] == 0
+        assert r["replicas"]["per_replica"][0]["health"] == "healthy"
+
+    def test_serving_fault_injection_failover_token_identical(self):
+        """--serve-fault-*: the routed arm loses a replica mid-trace
+        and still emits exactly the single engine's tokens, with the
+        fleet_faults block recording the failover."""
+        r = bench.measure_serving(num_requests=4, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=8,
+                                  precision="fp32", tiny=True,
+                                  replicas=2, fault_replica=0,
+                                  fault_step=3)
+        reps = r["replicas"]
+        assert reps["fleet_faults"]["failovers"] == 1
+        assert reps["fleet_faults"]["migrated_requests"] >= 1
+        assert reps["serve_fault"] == {"replica": 0, "step": 3,
+                                       "kind": "transient"}
+        assert reps["token_identical_vs_single"], \
+            "failover perturbed greedy outputs"
+
+    def test_serving_fault_knobs_validated(self, tmp_path):
+        with pytest.raises(ValueError, match="together"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  replicas=2, fault_replica=0)
+        with pytest.raises(ValueError, match="replicas"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  fault_replica=0, fault_step=3)
+        with pytest.raises(ValueError, match="outside the fleet"):
+            bench.measure_serving(num_requests=2, tiny=True, replicas=2,
+                                  fault_replica=5, fault_step=3)
+        with pytest.raises(ValueError, match="fault-kind"):
+            bench.measure_serving(num_requests=2, tiny=True, replicas=2,
+                                  fault_replica=0, fault_step=3,
+                                  fault_kind="flaky")
+        with pytest.raises(ValueError, match="fault-step"):
+            bench.measure_serving(num_requests=2, tiny=True, replicas=2,
+                                  fault_replica=0, fault_step=0)
+
+    def test_serving_fault_flags_guarded_at_argparse(self):
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "train", "--serve-fault-replica", "0",
+                        "--serve-fault-step", "3"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-fault-replica",
+                        "0"])               # step missing
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-fault-replica",
+                        "0", "--serve-fault-step", "3"])  # no fleet
+
     def test_serving_speculative_flags_guarded_at_argparse(self):
         """--serve-speculative/--serve-draft-k/--serve-spec-ab shape
         the serving trace; reject bad values and non-serving modes up

@@ -172,6 +172,103 @@ class TestServingSigkillReplay:
         assert got == want, "recovered outputs diverged from unfaulted run"
 
 
+class TestFleetSigkillReplay:
+    """The FLEET analogue of TestServingSigkillReplay (ISSUE 9): SIGKILL
+    a real ``bench.py --mode serving --serve-replicas 2 --serve-journal``
+    process mid-decode — journaling is per-replica (``<path>.r0`` /
+    ``<path>.r1``) — relaunch with the same arguments, and require the
+    merged recovered outputs to be TOKEN-IDENTICAL to an unfaulted
+    fleet run.  This is the combination PR 6 forbade (replicas x
+    journal were mutually exclusive); it now IS the fault-tolerant
+    fleet serve mode."""
+
+    N_REPLICAS = 2
+
+    def _bench(self, env, journal):
+        args = ["bench.py", "--mode", "serving", "--serve-tiny",
+                "--precision", "fp32", "--requests", "6",
+                "--prompt-len", "12", "--new-tokens", "80",
+                "--arrival-rate", "1000",
+                "--serve-replicas", str(self.N_REPLICAS),
+                "--serve-journal", journal]
+        return subprocess.Popen([sys.executable] + args, cwd=REPO, env=env,
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+
+    def _journal_toks(self, journal):
+        total = 0
+        for i in range(self.N_REPLICAS):
+            try:
+                with open(f"{journal}.r{i}") as f:
+                    total += sum('"tok"' in ln for ln in f)
+            except OSError:
+                pass
+        return total
+
+    @staticmethod
+    def _outputs(proc_stdout: str) -> tuple:
+        import json
+
+        rec = json.loads(proc_stdout.strip().splitlines()[-1])
+        return rec["detail"]["outputs"], rec["detail"]["statuses"]
+
+    def test_sigkill_fleet_then_replay_token_identical(self, tmp_path):
+        env = _cli_env()
+        journal = str(tmp_path / "fleet_journal.jsonl")
+
+        # run 1: SIGKILL once the per-replica journals show live
+        # mid-decode work (tokens recorded, far from the ~460-token
+        # completion)
+        proc = self._bench(env, journal)
+        try:
+            t0 = time.time()
+            killed = False
+            while time.time() - t0 < 600:
+                if proc.poll() is not None:
+                    break
+                if self._journal_toks(journal) >= 8:
+                    proc.send_signal(signal.SIGKILL)   # no grace
+                    proc.wait(timeout=30)
+                    killed = True
+                    break
+                time.sleep(0.005)
+            assert killed, "fleet bench never reached mid-decode state"
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+
+        # the merged journals must hold live (unterminated) work — a
+        # real crash, with both replicas' files present
+        from mpi_tensorflow_tpu.serving import ReplayJournal
+        from mpi_tensorflow_tpu.serving.recovery import \
+            merge_fleet_entries
+
+        journals = [ReplayJournal(f"{journal}.r{i}")
+                    for i in range(self.N_REPLICAS)]
+        live = [rid for rid, (ent, _j) in
+                merge_fleet_entries(journals).items()
+                if ent.status is None]
+        for j in journals:
+            j.close()
+        assert live, "SIGKILL landed after completion; nothing to replay"
+
+        # run 2: same journals — the fleet resumes and completes
+        proc2 = self._bench(env, journal)
+        out2, _ = proc2.communicate(timeout=900)
+        assert proc2.returncode == 0, out2
+        got, statuses = self._outputs(out2)
+        assert set(statuses.values()) == {"ok"}, statuses
+        assert len(statuses) == 6, statuses
+
+        # run 3: unfaulted fleet reference with fresh journals
+        proc3 = self._bench(env, str(tmp_path / "clean.jsonl"))
+        out3, _ = proc3.communicate(timeout=900)
+        assert proc3.returncode == 0, out3
+        want, _ = self._outputs(out3)
+        assert got == want, \
+            "recovered fleet outputs diverged from unfaulted run"
+
+
 class TestSigkillResume:
     def test_sigkill_mid_run_then_resume(self, tmp_path):
         """Kill -9 the training process after checkpoints commit; the
