@@ -398,6 +398,26 @@ def measure_decode(batch_size: int = 8, prompt_len: int = 32,
     }
 
 
+def make_serving_spec(*, workload: str = "poisson",
+                      num_requests: int = 24, rate_rps: float = 4.0,
+                      prompt_max: int = 32, output_max: int = 128,
+                      vocab_size: int = 32000, prefix_tokens: int = 0,
+                      slo_ms: float | None = None, seed: int = 0):
+    """The bench's trace description: measure_serving's knobs mapped
+    onto a ``serving.loadgen.WorkloadSpec`` (which validates them —
+    three-layer discipline: argparse choices, cli.py guard, spec).
+    Module-level on purpose: the byte-identity test builds the spec
+    through THIS seam and pins ``build_trace`` against the historical
+    inline generator."""
+    from mpi_tensorflow_tpu.serving import loadgen
+
+    return loadgen.WorkloadSpec(
+        workload=workload, num_requests=num_requests, rate_rps=rate_rps,
+        prompt_max=prompt_max, output_max=output_max,
+        vocab_size=vocab_size, prefix_tokens=prefix_tokens,
+        slo_ms=slo_ms, seed=seed)
+
+
 def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     max_slots: int | None = None,
                     pool_blocks: int | None = None,
@@ -420,16 +440,35 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     replicas: int | None = None,
                     fault_replica: int | None = None,
                     fault_step: int | None = None,
-                    fault_kind: str = "transient") -> dict:
+                    fault_kind: str = "transient",
+                    workload: str | None = None,
+                    slo_ms: float | None = None) -> dict:
     """Continuous-batching serving throughput vs the static-batch
-    ``generate`` baseline, on ONE synthetic Poisson request trace.
+    ``generate`` baseline, on ONE synthetic request trace built by
+    ``serving.loadgen`` from a seeded ``WorkloadSpec``.
 
-    Trace: ``num_requests`` requests, exponential inter-arrivals at
-    ``rate_rps``, prompt lengths uniform in [8, prompt_max], output
-    budgets uniform in [8, output_max] — the mixed-length regime where
-    static batching burns MXU cycles on finished rows (every batch
-    decodes to its LONGEST member) and continuous batching recycles the
-    slot the step a sequence finishes.
+    Trace (default ``workload="poisson"``): ``num_requests`` requests,
+    exponential inter-arrivals at ``rate_rps``, prompt lengths uniform
+    in [8, prompt_max], output budgets uniform in [8, output_max] — the
+    mixed-length regime where static batching burns MXU cycles on
+    finished rows (every batch decodes to its LONGEST member) and
+    continuous batching recycles the slot the step a sequence finishes.
+    The default trace is BYTE-IDENTICAL to the historical inline
+    generator (pinned by tests); ``workload`` picks bursty (2-state
+    MMPP), diurnal (raised-cosine envelope), or multi-tenant (MMPP
+    arrivals + interactive-vs-batch tenant mix with sticky sessions)
+    variants — see the loadgen module docstring's workload matrix.
+
+    SLO goodput: ``slo_ms`` stamps a per-request latency budget as
+    ``Request.deadline`` (riding the scheduler's existing TTL
+    machinery — late work sheds as ``deadline_exceeded``), and the
+    detail's ``goodput`` block reports tokens/sec and req/sec from
+    requests that FINISHED WITHIN BUDGET, with per-tenant attainment
+    and attained-latency percentiles — the serving number raw
+    tokens/sec over-reports under load (DistServe, arXiv:2401.09670).
+    The timed run also feeds a ``ScaleAdvisor`` (serving/autoscale) one
+    observation per engine iteration; its advisory scale-up/down
+    decision log lands in the detail's ``autoscale`` block.
 
     Both arms pay their compiles in an untimed warmup replay (the engine
     keeps its bucketed jit cache across ``reset``; the baseline warms
@@ -508,20 +547,13 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
 
     from mpi_tensorflow_tpu.config import Config
     from mpi_tensorflow_tpu.models import bert, gpt
-    from mpi_tensorflow_tpu.serving import (PagedDecodeEngine, Request,
-                                            ServeConfig)
+    from mpi_tensorflow_tpu.serving import (PagedDecodeEngine,
+                                            ServeConfig, autoscale,
+                                            loadgen)
     from mpi_tensorflow_tpu.serving.engine import pow2_ceil
     from mpi_tensorflow_tpu.serving.paged_cache import blocks_for
-    from mpi_tensorflow_tpu.utils import engagement
+    from mpi_tensorflow_tpu.utils import engagement, metrics_writer
 
-    if prompt_max < 1 or output_max < 1 or num_requests < 1:
-        raise ValueError(
-            f"serving trace needs >= 1 request/prompt/output token, got "
-            f"requests={num_requests} prompt_max={prompt_max} "
-            f"output_max={output_max}")
-    if prefix_tokens < 0:
-        raise ValueError(
-            f"--serve-prefix-tokens must be >= 0, got {prefix_tokens}")
     cfg = Config(precision=precision)
     # unset knobs resolve through the run Config's --serve-* defaults
     # (the one meaning of those knobs — serving.ServeConfig.from_config)
@@ -530,6 +562,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                   else cfg.serve_block_size)
     spec_mode = (speculative if speculative is not None
                  else cfg.serve_speculative)
+    workload = workload if workload is not None else cfg.serve_workload
+    slo_ms = slo_ms if slo_ms is not None else cfg.serve_slo_ms
     bcfg = dc.replace(bert.BERT_TINY if tiny else bert.BERT_BASE,
                       dtype=cfg.compute_dtype)
     if spec_mode != "off":
@@ -544,25 +578,21 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         # is internal to the run, and speculative-off runs keep the
         # historical learned-position trace byte-for-byte.
         bcfg = dc.replace(bcfg, pos_kind="rope")
+    # the trace: spec + seed -> loadgen.build_trace, ONE seeded
+    # generator, no wall clock — (spec, seed) reproduces the identical
+    # request list across warmup, timed, A/B, routed, and journal arms,
+    # and the default poisson/uniform spec replays the pre-loadgen
+    # inline generator byte-for-byte (pinned by tests/test_bench.py)
+    trace_spec = make_serving_spec(
+        workload=workload, num_requests=num_requests, rate_rps=rate_rps,
+        prompt_max=prompt_max, output_max=output_max,
+        vocab_size=bcfg.vocab_size, prefix_tokens=prefix_tokens,
+        slo_ms=slo_ms, seed=seed)
+    trace_b = loadgen.build_trace(trace_spec)
+    prompts, outputs, arrivals = (trace_b.prompts, trace_b.outputs,
+                                  trace_b.arrivals)
     model = gpt.CausalLm(bcfg)
     params = model.init(jax.random.key(0))
-    rng = np.random.default_rng(seed)
-    p_lo, o_lo = min(8, prompt_max), min(8, output_max)
-    # shared-prefix workload: one common N-token system prompt replayed
-    # in front of every request's unique tail (prefix_tokens=0 keeps
-    # the original all-unique trace byte-for-byte)
-    shared = (list(map(int, rng.integers(0, bcfg.vocab_size,
-                                         prefix_tokens)))
-              if prefix_tokens else [])   # 0: do not advance the rng —
-                                          # the no-prefix trace must stay
-                                          # byte-for-byte the historical one
-    prompts = [shared + list(map(int, rng.integers(0, bcfg.vocab_size,
-                                                   int(n))))
-               for n in rng.integers(p_lo, prompt_max + 1, num_requests)]
-    outputs = [int(n) for n in rng.integers(o_lo, output_max + 1,
-                                            num_requests)]
-    arrivals = np.cumsum(rng.exponential(1.0 / rate_rps, num_requests))
-    arrivals[0] = 0.0
     max_len = max(len(p) + o for p, o in zip(prompts, outputs))
     max_seq_len = pow2_ceil(max_len)
     bps = blocks_for(max_seq_len, block_size)
@@ -661,8 +691,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         }
 
     def trace():
-        return [Request(i, prompts[i], outputs[i], float(arrivals[i]))
-                for i in range(num_requests)]
+        # fresh Request objects per arm (engines mutate scheduling
+        # state on them); deadlines/sessions ride along from the spec
+        return trace_b.requests()
 
     from mpi_tensorflow_tpu.train.preemption import PreemptionGuard
 
@@ -706,6 +737,13 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "serve_draft_auto": serve.draft_auto,
             "serve_tp": serve.tp,
             "serve_replicas": replicas,
+            "serve_workload": workload,
+            "serve_slo_ms": slo_ms,
+            # journaled modes replay prior attempts' work into this
+            # run's clock — attained latencies would be skewed, so the
+            # goodput/autoscale blocks are timed-path-only
+            "goodput": None,
+            "autoscale": None,
             "serving_tokens_per_sec": rr["tokens_per_sec"],
             "p50_token_latency_ms": rr["p50_token_latency_ms"],
             "p99_token_latency_ms": rr["p99_token_latency_ms"],
@@ -769,6 +807,11 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "serve_draft_auto": serve.draft_auto,
             "serve_tp": serve.tp,
             "serve_replicas": 1,
+            "serve_workload": workload,
+            "serve_slo_ms": slo_ms,
+            # replayed attempts skew attained latency: timed-path-only
+            "goodput": None,
+            "autoscale": None,
             "peak_blocks_in_use": res.get("peak_blocks_in_use"),
             "peak_live_blocks": res.get("peak_live_blocks"),
             "serving_tokens_per_sec": res["tokens_per_sec"],
@@ -804,7 +847,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     warm_compiles = engine.compile_counts()
     engine.reset()
     with PreemptionGuard.installed() as guard:
-        cb = engine.run(trace(), guard=guard)
+        # the advisor rides the TIMED run only: warmup's compile stalls
+        # would read as phantom load spikes in the decision log
+        cb = engine.run(trace(), guard=guard,
+                        advisor=autoscale.ScaleAdvisor())
     steady_compiles = engine.compile_counts()
 
     ab = None
@@ -936,9 +982,11 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         # there would consume the one-shot plan before the arm it is
         # meant to exercise.  Token identity to the single engine must
         # hold across the failover — replay-by-prefix is exact.
-        rr = router.run(trace(), fault_plan=fault_plan)
+        rr = router.run(trace(), fault_plan=fault_plan,
+                        advisor=autoscale.ScaleAdvisor(replicas=replicas))
         replicas_detail = {
             "n": replicas,
+            "autoscale": rr["autoscale"],
             "fleet_faults": rr["fleet_faults"],
             "health": rr["health"],
             "serve_fault": (None if fault_replica is None else {
@@ -997,6 +1045,14 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     useful = sum(outputs)
     static_tps = useful / static_sec if static_sec > 0 else 0.0
 
+    # SLO goodput over the timed run: join trace metadata (tenant,
+    # arrival, per-request budget) with the run's finish stamps into
+    # the canonical goodput block — THE serving metric when slo_ms is
+    # set (raw tokens/sec over-reports under load)
+    goodput = metrics_writer.goodput_block(
+        loadgen.per_request_rows(trace_b, cb),
+        elapsed_s=cb["elapsed_s"])
+
     return {
         "model": "gpt_tiny" if tiny else "gpt_base",
         "kernel": engine.kernel,
@@ -1013,6 +1069,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "serve_draft_auto": serve.draft_auto,
         "serve_tp": serve.tp,
         "serve_replicas": replicas,
+        "serve_workload": workload,
+        "serve_slo_ms": slo_ms,
+        "goodput": goodput,
+        "autoscale": cb["autoscale"],
         "replicas": replicas_detail,
         "peak_blocks_in_use": cb["peak_blocks_in_use"],
         "peak_live_blocks": cb["peak_live_blocks"],
@@ -1393,6 +1453,18 @@ def _stale_score(args, d: dict, item=None):
                 or (d.get("replicas") or {}).get("serve_fault") \
                 is not None:
             return None
+        # the workload shapes the whole trace (arrival process, length
+        # distributions, tenants) and the SLO shapes its outcomes
+        # (deadline sheds, the goodput block) — a record measured under
+        # a different workload/SLO is a different number (absent keys
+        # on old records read as the pre-loadgen defaults: poisson, no
+        # SLO)
+        if d.get("serve_workload", "poisson") != \
+                (getattr(args, "serve_workload", None)
+                 or serve_defaults.serve_workload):
+            return None
+        if d.get("serve_slo_ms") != getattr(args, "serve_slo_ms", None):
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -1512,9 +1584,13 @@ def _report(args, d: dict, stale: bool = False) -> int:
     suffix = " [stale: last recorded TPU measurement]" if stale else ""
     if args.mode == "serving":
         sp = d.get("speedup_vs_static")
+        # the workload names the trace in the metric label (absent on
+        # old records = the historical Poisson trace)
+        wl = d.get("serve_workload", "poisson")
+        wl_label = "Poisson" if wl == "poisson" else wl
         out = {
             "metric": f"GPT-base continuous-batching serving throughput "
-                      f"(paged KV cache, Poisson trace){suffix}",
+                      f"(paged KV cache, {wl_label} trace){suffix}",
             "value": round(d["serving_tokens_per_sec"], 1),
             "unit": "tokens/sec",
             # >1 = continuous batching beats static-batch generate() on
@@ -1549,6 +1625,14 @@ def _report(args, d: dict, stale: bool = False) -> int:
             # THE scale-out line the replica flag exists for: the routed
             # fleet's aggregate rate over the single engine's
             out["replica_speedup"] = reps.get("speedup_vs_single_replica")
+        gp = d.get("goodput")
+        if gp and gp.get("enabled"):
+            # THE SLO numbers the workload/SLO knobs exist for: useful
+            # (within-budget) tokens/sec and the fraction of requests
+            # that met their deadline
+            out["goodput_tokens_per_sec"] = gp.get(
+                "goodput_tokens_per_sec")
+            out["slo_attainment"] = gp.get("slo_attainment")
         _print_json(out)
         return 0
     if args.mode == "decode":
@@ -1690,6 +1774,25 @@ def main(argv=None) -> int:
                     help="serving mode: requests in the Poisson trace")
     ap.add_argument("--arrival-rate", type=float, default=4.0,
                     help="serving mode: Poisson arrival rate (req/s)")
+    ap.add_argument("--serve-workload",
+                    choices=["poisson", "bursty", "multi-tenant",
+                             "diurnal"], default=None,
+                    help="serving mode: synthetic trace shape "
+                         "(serving/loadgen) — poisson (the historical "
+                         "byte-identical default), bursty (2-state MMPP "
+                         "on/off arrivals), multi-tenant (bursty "
+                         "arrivals + interactive-vs-batch tenant mix "
+                         "with per-tenant SLOs and sticky sessions), or "
+                         "diurnal (raised-cosine rate envelope). "
+                         "Default: the run Config's serve_workload")
+    ap.add_argument("--serve-slo-ms", type=float, default=None,
+                    help="serving mode: per-request latency budget — "
+                         "stamped as each request's deadline (late work "
+                         "sheds as deadline_exceeded) and the goodput "
+                         "block scores tokens/sec from requests that "
+                         "FINISHED within it, per tenant class "
+                         "(default: no SLO — goodput reads as raw "
+                         "delivered throughput)")
     ap.add_argument("--serve-pool-blocks", type=int, default=None,
                     help="serving mode: paged-KV pool blocks (default: "
                          "every slot can reach max length — no "
@@ -1928,6 +2031,12 @@ def main(argv=None) -> int:
         ap.error("--serve-replicas adds its own routed arm (aggregate "
                  "vs single engine); combine with --serve-kernel-ab/"
                  "--serve-spec-ab one at a time")
+    if (args.serve_workload is not None or args.serve_slo_ms is not None) \
+            and args.mode != "serving":
+        ap.error("--serve-workload/--serve-slo-ms shape the serving "
+                 "trace; other modes would silently ignore them")
+    if args.serve_slo_ms is not None and not args.serve_slo_ms > 0:
+        ap.error(f"--serve-slo-ms must be > 0, got {args.serve_slo_ms}")
     if (args.serve_fault_replica is not None
             or args.serve_fault_step is not None
             or args.serve_fault_kind != "transient") \
@@ -2038,7 +2147,9 @@ def main(argv=None) -> int:
                             replicas=args.serve_replicas,
                             fault_replica=args.serve_fault_replica,
                             fault_step=args.serve_fault_step,
-                            fault_kind=args.serve_fault_kind)
+                            fault_kind=args.serve_fault_kind,
+                            workload=args.serve_workload,
+                            slo_ms=args.serve_slo_ms)
         return _report(args, r)
 
     if args.mode == "decode":

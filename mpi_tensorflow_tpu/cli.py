@@ -224,6 +224,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "in-flight sequences finish inside it, the rest "
                         "terminate with status `drained` (default: "
                         "finish all in-flight work)")
+    p.add_argument("--serve-workload",
+                   choices=["poisson", "bursty", "multi-tenant",
+                            "diurnal"], default=d.serve_workload,
+                   help="serving: synthetic trace shape for bench "
+                        "--mode serving (serving/loadgen) — poisson is "
+                        "the historical byte-identical default; bursty "
+                        "= 2-state MMPP arrivals; multi-tenant adds an "
+                        "interactive-vs-batch tenant mix with "
+                        "per-tenant SLOs and sticky sessions; diurnal "
+                        "= raised-cosine rate envelope")
+    p.add_argument("--serve-slo-ms", type=float, default=d.serve_slo_ms,
+                   help="serving: per-request latency budget, stamped "
+                        "as each request's deadline; the goodput block "
+                        "scores tokens/sec from requests that finished "
+                        "within it (default: no SLO)")
     p.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
                    default=d.prng_impl,
                    help="dropout-mask PRNG: threefry (JAX default, "
@@ -278,6 +293,8 @@ def config_from_args(args) -> Config:
         serve_max_evictions=args.serve_max_evictions,
         serve_drain_ms=args.serve_drain_ms,
         serve_failover_backoff_ms=args.serve_failover_backoff_ms,
+        serve_workload=args.serve_workload,
+        serve_slo_ms=args.serve_slo_ms,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
@@ -374,6 +391,17 @@ def main(argv=None) -> int:
             f"{config.serve_max_evictions} (>= 1), drain-ms "
             f"{config.serve_drain_ms} (>= 0), failover-backoff-ms "
             f"{config.serve_failover_backoff_ms} (> 0)")
+    if config.serve_workload not in ("poisson", "bursty", "multi-tenant",
+                                     "diurnal"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-workload {config.serve_workload!r}: must be "
+            f"poisson|bursty|multi-tenant|diurnal")
+    if config.serve_slo_ms is not None and not config.serve_slo_ms > 0:
+        raise SystemExit(
+            f"bad --serve-slo-ms {config.serve_slo_ms}: the latency "
+            f"budget must be > 0 ms")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

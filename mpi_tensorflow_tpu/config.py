@@ -174,6 +174,16 @@ class Config:
                                   # consecutive fault and capped at
                                   # 64x before the replica is rebuilt
                                   # and probed back into rotation
+    serve_workload: str = "poisson"  # synthetic trace shape for bench
+                                  # --mode serving (serving/loadgen):
+                                  # poisson | bursty | multi-tenant |
+                                  # diurnal; poisson replays the
+                                  # historical trace byte-for-byte
+    serve_slo_ms: Optional[float] = None       # per-request latency
+                                  # budget stamped as Request.deadline;
+                                  # the goodput metric (tokens/sec
+                                  # within budget) keys on it (None =
+                                  # no SLO)
 
     # --- checkpointing (absent from the reference; SURVEY.md §5) ---
     checkpoint_dir: Optional[str] = None   # None = checkpointing off

@@ -49,6 +49,18 @@ Six cooperating layers, host-side policy over device-side math:
                      ``tp`` mesh axis via shard_map (one psum per
                      row-parallel output); block tables replicate, so
                      every host-side layer above stays tp-unaware.
+- ``loadgen``      — trace-driven load generation: a seeded
+                     ``WorkloadSpec`` builds the synthetic request
+                     trace (Poisson / bursty MMPP / diurnal /
+                     multi-tenant arrivals, heavy-tailed lengths,
+                     shared prefixes, per-request SLO deadlines, sticky
+                     sessions) — (spec, seed) reproduces the identical
+                     trace across runs, A/B arms, and replay.
+- ``autoscale``    — advisory replica auto-scaling: a ``ScaleAdvisor``
+                     folds the scheduler/router load signals (queue
+                     depth, occupancy, shed rate) into per-tick
+                     scale-up/down advice under hysteresis + cooldown,
+                     recorded in bench detail.
 - ``router``       — data-parallel scale-out WITH fleet fault
                      tolerance: N whole engine replicas (each with its
                      own replay journal) behind session-affinity +
@@ -80,3 +92,8 @@ from mpi_tensorflow_tpu.serving.scheduler import (  # noqa: F401
     Request, RejectedRequest, Scheduler, TERMINAL_STATUSES)
 from mpi_tensorflow_tpu.serving.speculative import (  # noqa: F401
     Drafter, DraftModelDrafter, NgramDrafter, make_drafter)
+from mpi_tensorflow_tpu.serving.loadgen import (  # noqa: F401
+    LENGTH_DISTS, TenantClass, Trace, WORKLOADS, WorkloadSpec,
+    build_trace, default_tenants, per_request_rows)
+from mpi_tensorflow_tpu.serving.autoscale import (  # noqa: F401
+    ScaleAdvisor, ScalePolicy)

@@ -815,7 +815,8 @@ class PagedDecodeEngine:
     # ---------------- request loop ----------------
 
     def run(self, requests: List[sched_lib.Request],
-            time_fn=time.perf_counter, *, guard=None, journal=None) -> dict:
+            time_fn=time.perf_counter, *, guard=None, journal=None,
+            advisor=None) -> dict:
         """Serve ``requests`` (replayed against their ``arrival`` stamps)
         to completion or graceful drain.  The per-token latency of a
         token is the wall time since the previous token of the SAME
@@ -833,6 +834,10 @@ class PagedDecodeEngine:
         ``journal`` (serving/recovery.ReplayJournal) records each
         request's prompt + generated prefix so a replacement process can
         replay live sequences token-identically.
+        ``advisor`` (serving/autoscale.ScaleAdvisor) observes the
+        scheduler's queue-depth / occupancy / shed-rate signals once
+        per iteration; its advisory decision log rides the result as
+        the ``autoscale`` block (None when no advisor is attached).
 
         The result dict carries per-request terminal ``statuses``, the
         ``faults`` health-counter block, and the ``drain`` outcome next
@@ -875,6 +880,8 @@ class PagedDecodeEngine:
             # end-ok can never precede its own finishing token
             emitted = loop.iterate(now, time_fn, t0)
             now = time_fn() - t0
+            if advisor is not None:
+                advisor.observe(now, **self.load_signals())
             if not emitted and not self._progressed:
                 # no work moved this iteration (idle gap before the next
                 # arrival, or live-but-stalled slots): sleep instead of
@@ -917,6 +924,29 @@ class PagedDecodeEngine:
             "p99_token_latency_ms": float(np.percentile(lat, 99)) * 1e3,
             "evictions": self.sched.evictions,
             "dispatch_shapes": sorted(self.dispatch_shapes),
+            # final-token emit time per request on the run clock (the
+            # same clock as Request.arrival): attained whole-request
+            # latency = finish - arrival (serving/loadgen goodput join)
+            "request_finish_s": dict(loop.last_emit),
+            "autoscale": (advisor.report() if advisor is not None
+                          else None),
+        }
+
+    def load_signals(self) -> dict:
+        """Instantaneous load signals for autoscale advice
+        (serving/autoscale.ScaleAdvisor.observe) — the same ingredients
+        as the router's least-load placement score: waiting-queue
+        depth, live-slot fraction, pool occupancy (block 0 is the
+        reserved null block), and the shed fraction of requests seen."""
+        live = len(self.sched.live_slots())
+        waiting = len(self.sched.waiting)
+        seen = max(1, waiting + live + len(self.sched.statuses))
+        return {
+            "queue_depth": waiting,
+            "live_fraction": live / self.serve.max_slots,
+            "occupancy": (self.allocator.num_used
+                          / max(1, self.serve.num_blocks - 1)),
+            "shed_rate": self.sched.counters["shed"] / seen,
         }
 
     def prefix_block(self) -> dict:

@@ -353,6 +353,12 @@ class ReplicaRouter:
         loop = self._loops[i]
         if loop is not None:
             self._lat_archive[i].extend(loop.latencies())
+            # finish stamps of the dead incarnation: completions it
+            # recorded stay valid; a migrated request's newer stamp on
+            # a survivor wins at aggregation (max merge)
+            for rid, t in loop.last_emit.items():
+                self._finish_archive[rid] = max(
+                    self._finish_archive.get(rid, t), t)
             self._tokens_archive[i] += loop.tokens
             self._peak_queue[i] = max(self._peak_queue[i],
                                       loop.peak_queue)
@@ -499,7 +505,8 @@ class ReplicaRouter:
             parallel: Optional[bool] = None, guard=None,
             journals: Optional[List] = None,
             replay_pre: Optional[Dict[int, List[int]]] = None,
-            fault_plan: Optional[FaultPlan] = None) -> dict:
+            fault_plan: Optional[FaultPlan] = None,
+            advisor=None) -> dict:
         """Serve ``requests`` (replayed against their ``arrival``
         stamps) across the replicas to completion, failing over replica
         faults.  Latency semantics match ``engine.run`` (the SHARED
@@ -516,7 +523,11 @@ class ReplicaRouter:
         and pass its ``pre`` map as ``replay_pre``); None = fresh
         memory-only journals, which is what arms in-process failover.
         ``fault_plan`` injects deterministic replica faults (tests/
-        bench)."""
+        bench).  ``advisor`` (serving/autoscale.ScaleAdvisor) observes
+        the FLEET-level load signals — router queue + summed replica
+        queues, mean pool occupancy, fleet shed rate — once per router
+        loop pass; its advisory decision log rides the result as the
+        ``autoscale`` block."""
         if parallel is None:
             parallel = default_parallelism()
         n = len(self.engines)
@@ -536,6 +547,8 @@ class ReplicaRouter:
         self._ticks = [0] * n
         self._loops: List[Optional[EngineLoop]] = [None] * n
         self._lat_archive: List[List[float]] = [[] for _ in range(n)]
+        self._finish_archive: Dict[int, float] = {}
+        self._advisor = advisor
         self._tokens_archive = [0] * n
         self._peak_queue = [0] * n
         self._counter_snap = [Counter() for _ in range(n)]
@@ -611,12 +624,40 @@ class ReplicaRouter:
         """True when no replica can ever serve again (all DEAD)."""
         return all(h.state == DEAD for h in self.health)
 
+    def _observe_fleet(self, now: float) -> None:
+        """Feed the ScaleAdvisor one fleet-level observation: router
+        backlog plus summed replica queues, mean occupancy/live fraction
+        over live replicas, fleet shed rate.  Reads of worker-owned
+        scheduler state are best-effort snapshots (len() on a deque/list
+        is atomic under the GIL); advice tolerates a stale tick."""
+        if self._advisor is None:
+            return
+        qd = len(self._pending) + sum(len(b) for b in self._inboxes)
+        occ, lf, live = 0.0, 0.0, 0
+        shed = 0
+        for i, eng in enumerate(self.engines):
+            shed += int(eng.sched.counters.get("shed", 0))
+            if self._loops[i] is None:
+                continue
+            live += 1
+            qd += len(eng.sched.waiting)
+            occ += eng.allocator.num_used / max(1, eng.serve.num_blocks - 1)
+            lf += len(eng.sched.live_slots()) / eng.serve.max_slots
+        routed = sum(self._routed)
+        self._advisor.observe(
+            now,
+            queue_depth=qd,
+            occupancy=occ / live if live else 0.0,
+            live_fraction=lf / live if live else 0.0,
+            shed_rate=shed / max(1, routed))
+
     def _run_sequential(self, time_fn, t0, guard) -> None:
         while True:
             now = time_fn() - t0
             self._drain_edges(now, guard)
             self._maybe_probe(now)
             self._route_due(now)
+            self._observe_fleet(now)
             progressed = False
             for i in list(self.routable()):
                 try:
@@ -687,6 +728,7 @@ class ReplicaRouter:
                 for i in self._maybe_probe(now):
                     start(i)
                 self._route_due(now)
+                self._observe_fleet(now)
                 with self._lock:
                     done = not self._outstanding
                 if done:
@@ -754,6 +796,13 @@ class ReplicaRouter:
         # donor prefix + survivor suffix) and across process restarts
         outputs = rec_lib.fleet_outputs(self._journals)
         statuses = rec_lib.fleet_statuses(self._journals)
+        # finish stamps: dead-incarnation archive, then live loops — a
+        # migrated request's survivor stamp (strictly later) wins
+        finish = dict(self._finish_archive)
+        for lp in self._loops:
+            if lp is not None:
+                for rid, t in lp.last_emit.items():
+                    finish[rid] = max(finish.get(rid, t), t)
         lat = np.asarray(flat) if flat else np.zeros(1)
         total = sum(len(v) for v in outputs.values())
         drain = self._drain.result_counts(self._drain_counts)
@@ -774,6 +823,9 @@ class ReplicaRouter:
             "tokens_per_sec": total / elapsed if elapsed > 0 else 0.0,
             "p50_token_latency_ms": float(np.percentile(lat, 50)) * 1e3,
             "p99_token_latency_ms": float(np.percentile(lat, 99)) * 1e3,
+            "request_finish_s": finish,
+            "autoscale": (self._advisor.report()
+                          if self._advisor is not None else None),
         }
 
     def compile_counts(self) -> dict:

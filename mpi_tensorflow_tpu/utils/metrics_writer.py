@@ -183,6 +183,78 @@ def speculation_block(counters, *, enabled: bool, mode: str = "off",
     }
 
 
+#: canonical goodput-under-SLO keys — THE shape of the ``goodput``
+#: block every consumer sees (bench.py --mode serving JSON, the metric
+#: line's goodput_tokens_per_sec / slo_attainment fields).  Goodput =
+#: tokens (and requests) per second from requests that completed within
+#: their latency budget (DistServe, arXiv:2401.09670) — the serving
+#: number raw tokens/sec over-reports under load.
+GOODPUT_KEYS = ("enabled", "requests", "ok_requests",
+                "slo_met_requests", "slo_attainment",
+                "goodput_tokens_per_sec", "goodput_requests_per_sec",
+                "p50_attained_ms", "p99_attained_ms", "per_tenant")
+
+
+def _percentile(vals, q: float) -> float:
+    """Linear-interpolation percentile over a small sample (no numpy:
+    this module stays importable by zero-dependency consumers)."""
+    if not vals:
+        return 0.0
+    s = sorted(vals)
+    k = (len(s) - 1) * q
+    f = int(k)
+    c = min(f + 1, len(s) - 1)
+    return s[f] + (s[c] - s[f]) * (k - f)
+
+
+def goodput_block(rows, *, elapsed_s: float, enabled=None) -> dict:
+    """Aggregate per-request rows (serving/loadgen.per_request_rows:
+    ``tenant`` / ``status`` / ``tokens`` / ``attained_ms`` / ``slo_ms``
+    each) into the canonical ``goodput`` block, with a per-tenant
+    breakdown keyed by tenant class.
+
+    A row MEETS its SLO when it finished ``ok`` within ``slo_ms``
+    (None = no budget, so every ``ok`` completion counts — goodput
+    degenerates to raw delivered throughput).  Attained-latency
+    percentiles cover completed requests only: an unfinished request
+    has no whole-request latency, and its miss is already counted by
+    ``slo_attainment``."""
+    rows = list(rows)
+    if enabled is None:
+        enabled = any(r.get("slo_ms") is not None for r in rows)
+
+    def agg(sub: list) -> dict:
+        ok = [r for r in sub if r.get("status") == "ok"]
+        met = [r for r in ok
+               if r.get("slo_ms") is None
+               or (r.get("attained_ms") is not None
+                   and r["attained_ms"] <= r["slo_ms"])]
+        att = [r["attained_ms"] for r in ok
+               if r.get("attained_ms") is not None]
+        toks = sum(int(r.get("tokens", 0)) for r in met)
+        return {
+            "requests": len(sub),
+            "ok_requests": len(ok),
+            "slo_met_requests": len(met),
+            "slo_attainment": (round(len(met) / len(sub), 4)
+                               if sub else 0.0),
+            "goodput_tokens_per_sec": (round(toks / elapsed_s, 2)
+                                       if elapsed_s > 0 else 0.0),
+            "goodput_requests_per_sec": (round(len(met) / elapsed_s, 4)
+                                         if elapsed_s > 0 else 0.0),
+            "p50_attained_ms": round(_percentile(att, 0.5), 2),
+            "p99_attained_ms": round(_percentile(att, 0.99), 2),
+        }
+
+    tenants = sorted({r.get("tenant", "default") for r in rows})
+    block = agg(rows)
+    block["enabled"] = bool(enabled)
+    block["per_tenant"] = {
+        t: agg([r for r in rows if r.get("tenant", "default") == t])
+        for t in tenants}
+    return block
+
+
 def write_faults(writer: MetricsWriter, counters, step: int = 0,
                  prefix: str = "serving/faults/") -> dict:
     """Stream the normalized faults block through a MetricsWriter (one

@@ -48,6 +48,9 @@
 #        T1_BUDGET=1200 scripts/t1_guard.sh         # grown suite
 #        T1_FILES="tests/test_router.py tests/test_fault_injection.py" \
 #            T1_CACHE_OFF=1 scripts/t1_guard.sh     # targeted, cache off
+#        T1_FILES="tests/test_loadgen.py tests/test_bench.py" \
+#            scripts/t1_guard.sh    # workload/goodput layer (loadgen is
+#                                   # host-only: seconds, no jax dispatch)
 
 set -u
 cd "$(dirname "$0")/.."

@@ -573,6 +573,76 @@ class TestMeasureServing:
         with pytest.raises(SystemExit):
             bench.main(["--mode", "serving", "--serve-spec-ab"])
 
+    def test_serving_default_trace_byte_identical_post_loadgen(self):
+        """THE refactor pin at the bench seam: make_serving_spec +
+        loadgen.build_trace on bench's default knobs reproduces the
+        pre-loadgen inline generator byte-for-byte (prompts, budgets,
+        arrival stamps) — host-only, no engine."""
+        import numpy as np
+
+        from mpi_tensorflow_tpu.serving import loadgen
+
+        spec = bench.make_serving_spec(vocab_size=32000)
+        t = loadgen.build_trace(spec)
+        # the historical inline generator, verbatim
+        rng = np.random.default_rng(0)
+        prompts = [list(map(int, rng.integers(0, 32000, int(n))))
+                   for n in rng.integers(8, 33, 24)]
+        outputs = [int(n) for n in rng.integers(8, 129, 24)]
+        arrivals = np.cumsum(rng.exponential(1.0 / 4.0, 24))
+        arrivals[0] = 0.0
+        assert t.prompts == prompts
+        assert t.outputs == outputs
+        assert np.array_equal(t.arrivals, arrivals)
+
+    def test_serving_workload_slo_goodput_and_autoscale(self, monkeypatch):
+        """The acceptance run: a bursty multi-tenant trace under an SLO
+        emits the goodput block (per-tenant attainment) and the
+        ScaleAdvisor decision log in detail — all on CPU."""
+        from mpi_tensorflow_tpu.models import bert
+
+        monkeypatch.setattr(bert, "BERT_BASE", bert.BERT_TINY)
+        r = bench.measure_serving(num_requests=6, rate_rps=1e6,
+                                  max_slots=2, block_size=8,
+                                  prompt_max=8, output_max=8,
+                                  precision="fp32",
+                                  workload="multi-tenant",
+                                  slo_ms=60000.0)
+        assert r["serve_workload"] == "multi-tenant"
+        assert r["serve_slo_ms"] == 60000.0
+        gp = r["goodput"]
+        assert gp["enabled"]
+        assert gp["requests"] == 6
+        assert set(gp["per_tenant"]) <= {"interactive", "batch"}
+        assert len(gp["per_tenant"]) >= 1
+        # generous SLO on a tiny trace: everything lands in budget
+        assert gp["slo_attainment"] == 1.0
+        assert gp["goodput_tokens_per_sec"] > 0
+        assert r["status_counts"] == {"ok": 6}
+        a = r["autoscale"]
+        assert a["ticks"] > 0 and isinstance(a["decisions"], list)
+        assert a["policy"]["hold_ticks"] >= 1
+        # sticky sessions from the interactive tenant rode the trace
+        assert r["zero_recompile_steady_state"] in (True, None)
+
+    def test_serving_workload_knobs_validated(self):
+        with pytest.raises(ValueError, match="serve-workload"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  workload="sinusoidal")
+        with pytest.raises(ValueError, match="serve-slo-ms"):
+            bench.measure_serving(num_requests=2, tiny=True,
+                                  slo_ms=0.0)
+
+    def test_serving_workload_flags_guarded_at_argparse(self):
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "train", "--serve-workload", "bursty"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "decode", "--serve-slo-ms", "100"])
+        with pytest.raises(SystemExit):
+            bench.main(["--mode", "serving", "--serve-slo-ms", "0"])
+        with pytest.raises(SystemExit):      # bad enum dies in argparse
+            bench.main(["--mode", "serving", "--serve-workload", "x"])
+
 
 class TestHostIo:
     def test_hostio_smoke_reports_all_paths(self):

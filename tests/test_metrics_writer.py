@@ -79,6 +79,43 @@ class TestMetricsWriter:
         assert z["accept_rate"] == 0.0 and z["mean_accepted_len"] == 0.0
         assert z["steps_saved"] == 0 and not z["enabled"]
 
+    def test_goodput_block_normalizes_rows(self):
+        """The canonical SLO-goodput block: attainment and within-budget
+        tokens/sec from per-request rows, with a per-tenant breakdown —
+        the one shape bench JSON and the metric line share."""
+        rows = [
+            # met: ok within budget
+            {"tenant": "interactive", "status": "ok", "tokens": 10,
+             "attained_ms": 50.0, "slo_ms": 100.0},
+            # missed: ok but past budget (slipped between sweeps)
+            {"tenant": "interactive", "status": "ok", "tokens": 10,
+             "attained_ms": 150.0, "slo_ms": 100.0},
+            # missed: deadline sweep already failed it
+            {"tenant": "batch", "status": "deadline_exceeded",
+             "tokens": 4, "attained_ms": None, "slo_ms": 400.0},
+            # met: no budget — any ok completion counts
+            {"tenant": "batch", "status": "ok", "tokens": 20,
+             "attained_ms": 300.0, "slo_ms": None},
+        ]
+        block = metrics_writer.goodput_block(rows, elapsed_s=2.0)
+        assert set(block) == set(metrics_writer.GOODPUT_KEYS)
+        assert block["enabled"]          # any row with an SLO enables it
+        assert block["requests"] == 4 and block["ok_requests"] == 3
+        assert block["slo_met_requests"] == 2
+        assert block["slo_attainment"] == 0.5
+        assert block["goodput_tokens_per_sec"] == 15.0   # (10+20)/2
+        assert block["goodput_requests_per_sec"] == 1.0
+        assert block["p50_attained_ms"] == 150.0
+        per = block["per_tenant"]
+        assert set(per) == {"interactive", "batch"}
+        assert per["interactive"]["slo_attainment"] == 0.5
+        assert per["batch"]["slo_met_requests"] == 1
+        # zero-safe: no rows, no elapsed time
+        z = metrics_writer.goodput_block([], elapsed_s=0.0)
+        assert set(z) == set(metrics_writer.GOODPUT_KEYS)
+        assert not z["enabled"] and z["slo_attainment"] == 0.0
+        assert z["goodput_tokens_per_sec"] == 0.0 and z["per_tenant"] == {}
+
     def test_write_faults_streams_one_scalar_per_counter(self, tmp_path):
         d = str(tmp_path / "m")
         with metrics_writer.MetricsWriter(d) as mw:

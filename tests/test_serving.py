@@ -898,6 +898,29 @@ class TestEngine:
         assert sorted(res["outputs"]) == [0, 1]
         assert clock["t"] > 0.5
 
+    def test_finish_stamps_and_advisor_observation(self):
+        """engine.run records a final-token finish stamp per completed
+        request (the goodput attained-latency seam) and feeds its load
+        signals into a ScaleAdvisor when one is passed."""
+        from mpi_tensorflow_tpu.serving.autoscale import ScaleAdvisor
+
+        _, _, engine = self._engine()
+        reqs = [Request(0, [1, 2, 3], 3, arrival=0.0),
+                Request(1, [4, 5], 2, arrival=0.1)]
+        res = engine.run(reqs)
+        assert res["autoscale"] is None          # advisory layer is opt-in
+        for r in reqs:
+            assert res["statuses"][r.id] == "ok"
+            assert res["request_finish_s"][r.id] >= r.arrival
+
+        engine.reset()
+        advisor = ScaleAdvisor()
+        res2 = engine.run([Request(0, [1, 2, 3], 3, arrival=0.0)],
+                          advisor=advisor)
+        assert res2["autoscale"] == advisor.report()
+        assert res2["autoscale"]["ticks"] > 0
+        assert res2["autoscale"]["replicas_advised"] >= 1
+
 
 # ----------------------------------------------------- prefix cache e2e
 
