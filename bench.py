@@ -430,6 +430,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     journal: str | None = None, tiny: bool = False,
                     kernel: str | None = None,
                     kernel_ab: bool = False,
+                    kv_dtype: str | None = None,
+                    kv_ab: bool = False,
                     prefix_cache: str | None = None,
                     prefix_tokens: int = 0,
                     speculative: str | None = None,
@@ -497,6 +499,22 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     same trace through the OTHER kernel (own warmup, own zero-recompile
     probe) and emits the speedup line — the control arm for validating
     the fused kernel on real hardware.
+
+    KV quantization: ``kv_dtype`` picks the paged-pool storage format
+    (--serve-kv-dtype: fp32|int8; None = the run Config's default) —
+    int8 stores symmetric-absmax codes with per-(block, head, slot)
+    fp32 row scales, dequantized inside the attention consume paths.
+    ``kv_ab`` replays the SAME trace under BOTH formats (each arm with
+    its own untimed warmup and zero-recompile probe, mirroring
+    ``kernel_ab`` and mutually exclusive with it and every other A/B
+    or control-arm mode — one comparison, one variable) and emits the
+    canonical ``kv_quant`` block: positionwise greedy token-match rate
+    vs the fp32 arm (THE quality gate — int8 outputs track fp32, they
+    are not bit-identical to it), the effective-capacity multiplier
+    (blocks the same HBM budget holds at quantized bytes-per-block),
+    the peak-live-blocks delta (same trace => same block walk => 0),
+    and the bytes-per-decode-token roofline at 1 byte/elem + scale
+    traffic.
 
     Prefix sharing: ``prefix_tokens > 0`` prepends a common N-token
     system prompt to every request (the shared-prefix production
@@ -603,7 +621,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     serve = ServeConfig.from_config(
         cfg, num_blocks=pool_blocks, block_size=block_size,
         max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
-        prefix_cache=prefix_cache, speculative=speculative,
+        kv_dtype=kv_dtype, prefix_cache=prefix_cache,
+        speculative=speculative,
         draft_k=draft_k, draft_auto=draft_auto, tp=tp,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
@@ -661,6 +680,30 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                          "arm; combining it with --serve-kernel-ab "
                          "would change two variables in one comparison "
                          "— pick one")
+    if kv_ab and journal is not None:
+        raise ValueError("--serve-kv-ab is a measurement (two timed "
+                         "arms); the journaled serve mode is not — pick "
+                         "one")
+    if kv_ab and (kernel_ab or spec_ab):
+        raise ValueError("--serve-kv-ab, --serve-kernel-ab and "
+                         "--serve-spec-ab each replay the trace through "
+                         "their own control arm; one comparison, one "
+                         "variable — pick one")
+    if kv_ab and replicas > 1:
+        raise ValueError("--serve-replicas adds its own comparison arm "
+                         "(aggregate vs single engine); combining it "
+                         "with --serve-kv-ab would change two variables "
+                         "in one comparison — pick one")
+    if kv_ab and serve.prefix_cache == "on":
+        raise ValueError("--serve-prefix-cache on adds its own "
+                         "cache-off control arm; combining it with "
+                         "--serve-kv-ab would change two variables in "
+                         "one comparison — pick one")
+    if kv_ab and serve.speculative != "off":
+        raise ValueError("--serve-speculative adds its own off control "
+                         "arm; combining it with --serve-kv-ab would "
+                         "change two variables in one comparison — "
+                         "pick one")
 
     def _roofline(resolved_kernel: str) -> dict:
         """Bytes-per-decode-token ESTIMATE for both lowerings, from the
@@ -730,6 +773,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "kernel": router.engines[0].kernel,
             "kernel_requested": kernel or cfg.serve_kernel,
             "roofline": _roofline(router.engines[0].kernel),
+            "serve_kv_dtype": serve.kv_dtype,
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
             "serve_speculative": serve.speculative,
@@ -798,6 +842,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "kernel": res.get("kernel"),
             "kernel_requested": kernel or cfg.serve_kernel,
             "roofline": _roofline(res.get("kernel")),
+            "serve_kv_dtype": serve.kv_dtype,
             "prefix": res.get("prefix"),
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
@@ -891,6 +936,66 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                 round(arms["pallas"] / arms["xla"], 3)
                 if "pallas" in arms and "xla" in arms and arms["xla"] > 0
                 else None),
+            "ab_zero_recompile": (w2 == s2
+                                  if all(v is not None for v in
+                                         {**w2, **s2}.values()) else None),
+        }
+
+    kv_detail = None
+    if kv_ab:
+        # the SAME trace through the OTHER pool storage format: own
+        # engine, own untimed warmup (both arms compare steady state),
+        # own zero-recompile probe — quantized pools must honor the
+        # bucket contract too (codes and scale siblings are fixed-shape
+        # engine state, so nothing about the dispatch shapes changes).
+        # Arms are oriented fp32=reference / int8=quantized regardless
+        # of which one the timed engine ran.
+        other_dt = "int8" if serve.kv_dtype == "fp32" else "fp32"
+        eng2 = PagedDecodeEngine(
+            model, params, dc.replace(serve, kv_dtype=other_dt))
+        eng2.run(trace())
+        w2 = eng2.compile_counts()
+        eng2.reset()
+        cb2 = eng2.run(trace())
+        s2 = eng2.compile_counts()
+        cb_fp32, cb_int8 = ((cb, cb2) if serve.kv_dtype == "fp32"
+                            else (cb2, cb))
+        # positionwise greedy agreement over the whole trace; a length
+        # mismatch counts every unpaired position as a mismatch (the
+        # honest denominator — early divergence must not shrink it)
+        matched = compared = 0
+        for rid, ref_out in cb_fp32["outputs"].items():
+            q_out = cb_int8["outputs"].get(rid, [])
+            compared += max(len(ref_out), len(q_out))
+            matched += sum(a == b for a, b in zip(ref_out, q_out))
+        # bytes per pool block across all layers: fp32 stores K and V
+        # rows at the compute dtype's width; int8 stores 1-byte codes
+        # plus one fp32 scale per (head, slot) row — the +4/D overhead
+        itemsize = int(jnp.dtype(cfg.compute_dtype).itemsize)
+        rows = bcfg.heads * serve.block_size          # rows per block
+        fp32_block = 2 * rows * bcfg.head_dim * itemsize * bcfg.layers
+        int8_block = 2 * rows * (bcfg.head_dim + 4) * bcfg.layers
+        # decode-bandwidth roofline at the streaming (pallas) cost
+        # model: one read of the live context's K and V rows per token
+        mean_ctx = float(np.mean([len(p) + t + 1
+                                  for p, o in zip(prompts, outputs)
+                                  for t in range(o)]))
+        fp32_bpt = bcfg.layers * 2 * bcfg.heads * bcfg.head_dim \
+            * itemsize * mean_ctx
+        int8_bpt = bcfg.layers * 2 * bcfg.heads * (bcfg.head_dim + 4) \
+            * mean_ctx
+        kv_detail = {
+            **metrics_writer.kv_quant_block(
+                kv_dtype="int8",
+                matched_tokens=matched, compared_tokens=compared,
+                block_bytes_ref=fp32_block, block_bytes=int8_block,
+                num_blocks=serve.num_blocks,
+                peak_live_blocks_ref=cb_fp32["peak_live_blocks"],
+                peak_live_blocks=cb_int8["peak_live_blocks"],
+                bytes_per_decode_token_ref=fp32_bpt,
+                bytes_per_decode_token=int8_bpt),
+            "tokens_per_sec": {"fp32": cb_fp32["tokens_per_sec"],
+                               "int8": cb_int8["tokens_per_sec"]},
             "ab_zero_recompile": (w2 == s2
                                   if all(v is not None for v in
                                          {**w2, **s2}.values()) else None),
@@ -1059,6 +1164,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "kernel_requested": kernel or cfg.serve_kernel,
         "roofline": _roofline(engine.kernel),
         "kernel_ab": ab,
+        "kv_quant": kv_detail,
+        "serve_kv_dtype": serve.kv_dtype,
         "prefix": prefix_detail,
         "serve_prefix_cache": serve.prefix_cache,
         "serve_prefix_tokens": prefix_tokens,
@@ -1401,6 +1508,17 @@ def _stale_score(args, d: dict, item=None):
             return None
         if d.get("kernel_requested", "auto") != \
                 (getattr(args, "serve_kernel", None) or "auto"):
+            return None
+        # the pool storage format shapes the number (quantized pools
+        # stream different bytes AND may emit different tokens); a kv
+        # A/B request is two live arms by definition (absent keys on
+        # old records read as the pre-quantization defaults: fp32
+        # pools, no A/B)
+        if getattr(args, "serve_kv_ab", False) or d.get("kv_quant"):
+            return None
+        if d.get("serve_kv_dtype", "fp32") != \
+                (getattr(args, "serve_kv_dtype", None)
+                 or serve_defaults.serve_kv_dtype):
             return None
         # prefix sharing changes both the trace (the shared system
         # prompt) and the pool behavior — a record measured under a
@@ -1827,6 +1945,24 @@ def main(argv=None) -> int:
                          "BOTH kernels (each with its own warmup and "
                          "zero-recompile probe) and emit the "
                          "pallas-vs-xla speedup line")
+    ap.add_argument("--serve-kv-dtype", choices=["fp32", "int8"],
+                    default=None,
+                    help="serving mode: paged-pool storage format — "
+                         "int8 stores symmetric-absmax codes plus "
+                         "per-(block, head, slot) fp32 row scales "
+                         "(~4x effective KV capacity at bf16 compute; "
+                         "dequantized inside the attention consume "
+                         "paths, greedy outputs gated on token-match "
+                         "rate vs fp32) (default: the run Config's "
+                         "serve_kv_dtype)")
+    ap.add_argument("--serve-kv-ab", action="store_true",
+                    help="serving mode: replay the same trace under "
+                         "BOTH pool formats (fp32 and int8, each with "
+                         "its own warmup and zero-recompile probe) and "
+                         "emit the kv_quant block — token-match rate "
+                         "vs fp32, effective-capacity multiplier, "
+                         "peak-live-blocks delta, and the bytes-per-"
+                         "decode-token roofline at 1 byte/elem")
     ap.add_argument("--serve-journal", default=None,
                     help="serving mode: fault-tolerant serve — journal "
                          "each request's prompt + generated prefix here "
@@ -2023,14 +2159,35 @@ def main(argv=None) -> int:
         ap.error(f"--serve-replicas must be >= 1, got "
                  f"{args.serve_replicas}")
     if args.serve_replicas is not None and args.serve_replicas > 1 \
-            and (args.serve_kernel_ab or args.serve_spec_ab):
+            and (args.serve_kernel_ab or args.serve_spec_ab
+                 or args.serve_kv_ab):
         # NOTE: --serve-replicas + --serve-journal is now a SUPPORTED
         # combination (the fault-tolerant fleet serve mode with one
         # journal per replica); only the two-timed-arms A/B modes stay
         # mutually exclusive with the routed arm
         ap.error("--serve-replicas adds its own routed arm (aggregate "
                  "vs single engine); combine with --serve-kernel-ab/"
-                 "--serve-spec-ab one at a time")
+                 "--serve-spec-ab/--serve-kv-ab one at a time")
+    if (args.serve_kv_dtype is not None or args.serve_kv_ab) \
+            and args.mode != "serving":
+        ap.error("--serve-kv-dtype/--serve-kv-ab shape the serving "
+                 "pool; other modes would silently ignore them")
+    if args.serve_kv_ab and (args.serve_kernel_ab or args.serve_spec_ab):
+        ap.error("--serve-kv-ab, --serve-kernel-ab and --serve-spec-ab "
+                 "each replay the trace through their own control arm; "
+                 "one comparison, one variable — pick one")
+    if args.serve_kv_ab and args.serve_journal:
+        ap.error("--serve-kv-ab is a measurement (two timed arms); the "
+                 "journaled serve mode is not — pick one")
+    if args.serve_kv_ab and args.serve_prefix_cache == "on":
+        ap.error("--serve-prefix-cache on already adds its own "
+                 "cache-off control arm; combine with --serve-kv-ab "
+                 "one at a time so each comparison has a single "
+                 "variable")
+    if args.serve_kv_ab and args.serve_speculative not in (None, "off"):
+        ap.error("--serve-speculative already adds its own off control "
+                 "arm; combine with --serve-kv-ab one at a time so "
+                 "each comparison has a single variable")
     if (args.serve_workload is not None or args.serve_slo_ms is not None) \
             and args.mode != "serving":
         ap.error("--serve-workload/--serve-slo-ms shape the serving "
@@ -2137,6 +2294,8 @@ def main(argv=None) -> int:
                             tiny=args.serve_tiny,
                             kernel=args.serve_kernel,
                             kernel_ab=args.serve_kernel_ab,
+                            kv_dtype=args.serve_kv_dtype,
+                            kv_ab=args.serve_kv_ab,
                             prefix_cache=args.serve_prefix_cache,
                             prefix_tokens=args.serve_prefix_tokens,
                             speculative=args.serve_speculative,

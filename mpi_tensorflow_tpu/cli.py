@@ -148,6 +148,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "compile probe passes and the XLA gather path "
                         "otherwise; xla/pallas force one side "
                         "(ops/paged_attention.resolve_kernel)")
+    p.add_argument("--serve-kv-dtype", choices=["fp32", "int8"],
+                   default=d.serve_kv_dtype,
+                   help="serving: paged-pool storage format — fp32 "
+                        "keeps the blocks in the model compute dtype "
+                        "(byte-for-byte the pre-quantization pool); "
+                        "int8 stores symmetric-absmax codes with "
+                        "per-(block, head, slot) fp32 row scales "
+                        "(~4x effective KV capacity), dequantized "
+                        "inside the attention consume paths "
+                        "(serving/paged_cache, ops/paged_attention)")
     p.add_argument("--serve-prefix-cache", choices=["off", "on"],
                    default=d.serve_prefix_cache,
                    help="serving: radix prefix cache — on shares "
@@ -282,6 +292,7 @@ def config_from_args(args) -> Config:
         serve_max_slots=args.serve_max_slots,
         serve_max_seq_len=args.serve_max_seq_len,
         serve_kernel=args.serve_kernel,
+        serve_kv_dtype=args.serve_kv_dtype,
         serve_prefix_cache=args.serve_prefix_cache,
         serve_speculative=args.serve_speculative,
         serve_draft_k=args.serve_draft_k,
@@ -344,6 +355,12 @@ def main(argv=None) -> int:
             f"block-size {config.serve_block_size} (>= 1), max-slots "
             f"{config.serve_max_slots} (>= 1), max-seq-len "
             f"{config.serve_max_seq_len} (>= 1)")
+    if config.serve_kv_dtype not in ("fp32", "int8"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-kv-dtype {config.serve_kv_dtype!r}: "
+            f"must be fp32|int8")
     if config.serve_prefix_cache not in ("off", "on"):
         # argparse choices guard the CLI path; this covers programmatic
         # Config construction routed through main

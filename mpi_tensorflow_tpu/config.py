@@ -111,6 +111,16 @@ class Config:
                                   # (force the exact gather fallback),
                                   # pallas (force the kernel; interpret
                                   # mode off TPU — the test path)
+    serve_kv_dtype: str = "fp32"  # paged-pool storage format: "fp32"
+                                  # (blocks in the model compute dtype —
+                                  # byte-for-byte the pre-quantization
+                                  # behavior) | "int8" (symmetric-absmax
+                                  # codes + per-(block, head, slot) fp32
+                                  # row scales: ~4x effective KV
+                                  # capacity, dequantized inside the
+                                  # attention consume paths; greedy
+                                  # outputs track fp32 at a token-match-
+                                  # rate gate, not token identity)
     serve_prefix_cache: str = "off"  # radix prefix cache: "on" shares
                                   # already-cached full prompt blocks
                                   # across requests (refcounted, copy-

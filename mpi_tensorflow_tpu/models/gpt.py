@@ -171,7 +171,12 @@ class CausalLm(bert_lib.BertMlm):
 
         pools:        per-layer [{"k", "v"}] block pools, each
                       (num_blocks, H, block_size, D) — head-major,
-                      ops/paged_attention's layout
+                      ops/paged_attention's layout.  An int8 pool
+                      (--serve-kv-dtype int8) additionally carries
+                      {"k_scale", "v_scale"} (num_blocks, H, block_size)
+                      fp32 row scales (serving/paged_cache.init_pools);
+                      writes then quantize on store and attention
+                      dequantizes inside the consume path
         block_tables: (B, NB) int32 pool block ids, position order;
                       entries beyond a row's allocation must be the null
                       block (0)
@@ -234,11 +239,28 @@ class CausalLm(bert_lib.BertMlm):
                 q = bert_lib.rope(q, pos)
                 k = bert_lib.rope(k, pos)
             q = self._constrain(q, qkv_axes)
-            pk = paged_ops.write_kv(pl["k"], k, block_tables, pos, valid)
-            pv = paged_ops.write_kv(pl["v"], v, block_tables, pos, valid)
-            new_pools.append({"k": pk, "v": pv})
-            a = paged_ops.attend(q, pk, pv, block_tables, lengths, dt,
-                                 kernel=kernel)
+            if "k_scale" in pl:
+                # int8 pool (--serve-kv-dtype int8): quantize on store —
+                # codes and per-row scales scatter through the same
+                # block/offset indexing — and consume through attend's
+                # dequantizing paths; the fp K/V never touch the pool
+                pk, ks = paged_ops.write_kv_quant(
+                    pl["k"], pl["k_scale"], k, block_tables, pos, valid)
+                pv, vs = paged_ops.write_kv_quant(
+                    pl["v"], pl["v_scale"], v, block_tables, pos, valid)
+                new_pools.append({"k": pk, "v": pv,
+                                  "k_scale": ks, "v_scale": vs})
+                a = paged_ops.attend(q, pk, pv, block_tables, lengths,
+                                     dt, kernel=kernel,
+                                     k_scale=ks, v_scale=vs)
+            else:
+                pk = paged_ops.write_kv(pl["k"], k, block_tables, pos,
+                                        valid)
+                pv = paged_ops.write_kv(pl["v"], v, block_tables, pos,
+                                        valid)
+                new_pools.append({"k": pk, "v": pv})
+                a = paged_ops.attend(q, pk, pv, block_tables, lengths,
+                                     dt, kernel=kernel)
             a = bert_lib.attn_out_proj(lp, a, dt, reduce=reduce)
             h = _layernorm(h + a, lp["ln1"]).astype(dt)
             h = self._constrain(h, ("batch", "seq", "embed"))

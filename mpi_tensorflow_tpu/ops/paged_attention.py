@@ -102,6 +102,70 @@ def write_kv(pool, kv, block_table, positions, valid):
     return pool.at[blk, :, off].set(vals.astype(pool.dtype))
 
 
+def quantize_kv(kv):
+    """Symmetric absmax int8 quantization, one scale per (B, H, S) row.
+
+    kv: (B, H, S, D) fp K or V vectors.  Returns ``(codes, scales)``:
+    codes (B, H, S, D) int8, scales (B, H, S) fp32 with
+    ``scale = max|row| / 127`` (0.0 for an all-zero row).
+
+    The scale granularity is deliberately PER TOKEN ROW, not per whole
+    block: a row's codes depend only on its own fp values, never on
+    which other tokens share the block or on how many tokens the write
+    dispatch carried.  That makes quantization GRANULARITY-INDEPENDENT —
+    chunked prefill, single-token decode, speculative verify, and
+    journal replay all produce bit-identical pool bytes for the same
+    token stream (the determinism contract the int8 composition tests
+    pin) — and gives the exact elementwise bound
+    ``|dequant - x| <= max|row| / 127 / 2 * 2 = amax/127`` (half a
+    quantization step from round-half-even, bounded by one step).
+
+    Rounding is ``jnp.round`` (round-half-even, deterministic across
+    backends); stochastic rounding would break replay byte-identity.
+    """
+    x = kv.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(x), axis=-1)                          # (B, H, S)
+    scale = amax / 127.0
+    safe = jnp.where(scale > 0.0, scale, 1.0)[..., None]
+    codes = jnp.clip(jnp.round(x / safe), -127, 127).astype(jnp.int8)
+    return codes, scale
+
+
+def write_kv_quant(pool, pool_scale, kv, block_table, positions, valid):
+    """``write_kv`` for the int8 pool: quantize the incoming rows
+    (``quantize_kv``) and scatter codes AND scales through the same
+    block/offset indexing.
+
+    pool:        (num_blocks, H, block_size, D) int8 codes
+    pool_scale:  (num_blocks, H, block_size) fp32 row scales
+    kv/block_table/positions/valid: as ``write_kv``
+
+    Returns ``(pool, pool_scale)`` updated.  Each write dispatch
+    computes fresh scales for exactly the rows it writes — invalid
+    lanes land codes and scales in the null block, never read unmasked.
+    """
+    bs = pool.shape[2]
+    nb = block_table.shape[1]
+    blk_idx = jnp.clip(positions // bs, 0, nb - 1)
+    blk = jnp.take_along_axis(block_table, blk_idx, axis=1)      # (B, S)
+    blk = jnp.where(valid, blk, NULL_BLOCK)
+    off = positions % bs
+    codes, scale = quantize_kv(kv)
+    vals = jnp.transpose(codes, (0, 2, 1, 3))                    # (B, S, H, D)
+    sv = jnp.transpose(scale, (0, 2, 1))                         # (B, S, H)
+    return (pool.at[blk, :, off].set(vals),
+            pool_scale.at[blk, :, off].set(sv))
+
+
+def dequantize_kv(codes, scale, dt):
+    """THE int8->fp dequantization, shared verbatim (in math) by the
+    XLA gather path below and the Pallas kernel's in-register step
+    (ops/paged_attention_kernel) so the two lowerings stay in lockstep:
+    ``(codes.astype(f32) * scale).astype(dt)``, scale broadcast over the
+    trailing D axis."""
+    return (codes.astype(jnp.float32) * scale[..., None]).astype(dt)
+
+
 def gather_kv(pool, block_table):
     """Reassemble a (B, H, L, D) contiguous view from the pool.
 
@@ -135,7 +199,7 @@ def paged_attention(q, ck, cv, q_positions, dt):
 
 
 def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
-           kernel: str = "xla"):
+           kernel: str = "xla", k_scale=None, v_scale=None):
     """THE paged-attention dispatch seam: one entry point, two lowering
     strategies, identical greedy tokens (tests/test_paged_kernel.py).
 
@@ -150,7 +214,14 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
                  TPU).  Callers resolve "auto" BEFORE tracing via
                  ``resolve_kernel`` — this runs under jit, where the
                  choice must be static.
+    k/v_scale:   (num_blocks, H, block_size) fp32 row scales when the
+                 pools hold int8 codes (--serve-kv-dtype int8); both or
+                 neither.  Dequantization happens INSIDE the consume
+                 path — in-register in the kernel, elementwise on the
+                 gathered view here — so no fp pool ever materializes.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("int8 pools need both k_scale and v_scale")
     if kernel == "pallas":
         from mpi_tensorflow_tpu.ops import paged_attention_kernel as pk
 
@@ -158,20 +229,39 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
         fused = (pk.paged_decode_attention if q.shape[2] == 1
                  else pk.paged_prefill_attention)
         return fused(q, k_pool, v_pool, block_table, lengths,
-                     interpret=interpret)
+                     interpret=interpret, k_scale=k_scale,
+                     v_scale=v_scale)
     if kernel != "xla":
         raise ValueError(
             f"unresolved paged-attention kernel {kernel!r}: callers "
             f"resolve 'auto' host-side via resolve_kernel before tracing")
     S = q.shape[2]
     pos = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)
-    ck = gather_kv(k_pool, block_table)
-    cv = gather_kv(v_pool, block_table)
+    if k_scale is not None:
+        # dequantize the gathered blocks elementwise, in lockstep with
+        # the kernel's in-register step (dequantize_kv is the shared
+        # contract), BEFORE the unchanged transpose/reshape + softmax
+        ck = _gather_kv_dequant(k_pool, k_scale, block_table, q.dtype)
+        cv = _gather_kv_dequant(v_pool, v_scale, block_table, q.dtype)
+    else:
+        ck = gather_kv(k_pool, block_table)
+        cv = gather_kv(v_pool, block_table)
     return paged_attention(q, ck, cv, pos, dt)
 
 
+def _gather_kv_dequant(pool, pool_scale, block_table, dt):
+    """``gather_kv`` over an int8 pool: gather codes and scales through
+    the same table, dequantize, reassemble the (B, H, L, D) view."""
+    g = pool[block_table]                        # (B, NB, H, bs, D) int8
+    gs = pool_scale[block_table]                 # (B, NB, H, bs) f32
+    g = dequantize_kv(g, gs, dt)
+    B, NB, H, bs, D = g.shape
+    return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(B, H, NB * bs, D)
+
+
 def resolve_kernel(choice: str, cfg, block_size: int,
-                   prefill_chunk: int = 64) -> str:
+                   prefill_chunk: int = 64,
+                   kv_dtype: str = "fp32") -> str:
     """Resolve the ``--serve-kernel`` knob to a static lowering choice.
 
     - "xla"    -> "xla"     (always available, exact)
@@ -197,5 +287,6 @@ def resolve_kernel(choice: str, cfg, block_size: int,
     from mpi_tensorflow_tpu.ops import paged_attention_kernel as pk
 
     ok = pk.kernel_supported(jnp.dtype(cfg.dtype).name, cfg.heads,
-                             cfg.head_dim, block_size, prefill_chunk)
+                             cfg.head_dim, block_size, prefill_chunk,
+                             kv_dtype)
     return "pallas" if ok else "xla"

@@ -67,8 +67,8 @@ def _nlive(length, S: int, bs: int, NB: int):
     return jnp.clip((length + S + bs - 1) // bs, 1, NB)
 
 
-def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                  acc, m_scr, l_scr, *, scale: float, block_size: int):
+def _paged_kernel(*refs, scale: float, block_size: int,
+                  quantized: bool = False):
     """One (batch-slot, kv-block) grid step of the online softmax.
 
     q_ref:  (1, H, S, D)   — the row's whole query block (revisited)
@@ -76,7 +76,23 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     v_ref:  (1, H, bs, D)
     o_ref:  (1, H, S, D)   — written once, at the last LIVE block
     scratch: acc (H, S, D) f32, m/l (H, S, STAT_LANES) f32
+
+    ``quantized`` (int8 pools, --serve-kv-dtype int8): k/v_ref hold int8
+    codes and two extra refs ride between them — ks_ref/vs_ref, the
+    ``(1, H, bs)`` fp32 row scales of the SAME pool block (their
+    BlockSpec shares the kv index map, so code block and scale block can
+    never skew).  The codes dequantize IN REGISTER right here —
+    ``(codes.astype(f32) * scale).astype(q.dtype)``, the exact
+    ops/paged_attention.dequantize_kv contract the XLA gather path
+    applies elementwise — before the unchanged fp32 matmul/softmax; no
+    fp pool ever materializes.
     """
+    if quantized:
+        (bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
+         acc, m_scr, l_scr) = refs
+    else:
+        (bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+         acc, m_scr, l_scr) = refs
     b = pl.program_id(0)
     j = pl.program_id(1)
     NB = pl.num_programs(1)
@@ -95,6 +111,11 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0]                                   # (H, S, D)
         k = k_ref[0]                                   # (H, bs, D)
         v = v_ref[0]
+        if quantized:
+            k = (k.astype(jnp.float32)
+                 * ks_ref[0][..., None]).astype(q.dtype)
+            v = (v.astype(jnp.float32)
+                 * vs_ref[0][..., None]).astype(q.dtype)
         s = lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)        # (H, S, bs)
@@ -124,10 +145,12 @@ def _paged_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
-                scale: float, interpret: bool):
+                scale: float, interpret: bool,
+                k_scale=None, v_scale=None):
     B, H, S, D = q.shape
     NB = block_table.shape[1]
     bs = k_pool.shape[2]
+    quantized = k_scale is not None
 
     def kv_map(b, j, bt, lens):
         # clamp dead steps to the last live block: the repeated index
@@ -136,17 +159,38 @@ def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
         jl = jnp.minimum(j, _nlive(lens[b], S, bs, NB) - 1)
         return (bt[b, jl], 0, 0, 0)
 
+    def ks_map(b, j, bt, lens):
+        # the scale sibling of kv_map: same clamped block id, 3-D block
+        jl = jnp.minimum(j, _nlive(lens[b], S, bs, NB) - 1)
+        return (bt[b, jl], 0, 0)
+
     def q_map(b, j, bt, lens):
         return (b, 0, 0, 0)
+
+    if quantized:
+        # scales ride as regular streamed inputs indexed by the SAME
+        # (clamped) block id as their code block — each grid step DMAs
+        # the (1, H, bs) scale rows next to the (1, H, bs, D) codes
+        in_specs = [
+            pl.BlockSpec((1, H, S, D), q_map),
+            pl.BlockSpec((1, H, bs, D), kv_map),
+            pl.BlockSpec((1, H, bs), ks_map),
+            pl.BlockSpec((1, H, bs, D), kv_map),
+            pl.BlockSpec((1, H, bs), ks_map),
+        ]
+        operands = (q, k_pool, k_scale, v_pool, v_scale)
+    else:
+        in_specs = [
+            pl.BlockSpec((1, H, S, D), q_map),
+            pl.BlockSpec((1, H, bs, D), kv_map),
+            pl.BlockSpec((1, H, bs, D), kv_map),
+        ]
+        operands = (q, k_pool, v_pool)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, NB),
-        in_specs=[
-            pl.BlockSpec((1, H, S, D), q_map),
-            pl.BlockSpec((1, H, bs, D), kv_map),
-            pl.BlockSpec((1, H, bs, D), kv_map),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, S, D), q_map),
         scratch_shapes=[
             pltpu.VMEM((H, S, D), jnp.float32),
@@ -155,16 +199,18 @@ def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
         ],
     )
     return pl.pallas_call(
-        functools.partial(_paged_kernel, scale=scale, block_size=bs),
+        functools.partial(_paged_kernel, scale=scale, block_size=bs,
+                          quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         interpret=interpret,
     )(block_table.astype(jnp.int32), lengths.astype(jnp.int32),
-      q, k_pool, v_pool)
+      *operands)
 
 
 def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
-                           scale=None, interpret: bool = False):
+                           scale=None, interpret: bool = False,
+                           k_scale=None, v_scale=None):
     """Fused paged attention over pool blocks — no gathered view.
 
     q:           (B, H, S, D) queries; S=1 decode, S=chunk prefill
@@ -177,19 +223,27 @@ def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
                  queries occupy absolute positions
                  [lengths[b], lengths[b] + S) and their K/V must already
                  be scattered into the pool (write_kv runs first)
+    k/v_scale:   (num_blocks, H, block_size) fp32 row scales when the
+                 pools hold int8 codes (both or neither); the kernel
+                 streams them beside the code blocks and dequantizes in
+                 register (see _paged_kernel)
 
     Returns (B, H, S, D) in q.dtype.  Numerically this is the online-
     softmax evaluation of ops/paged_attention.paged_attention over the
     gathered view — token-parity on the greedy decode path is pinned by
     tests/test_paged_kernel.py.
     """
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("int8 pools need both k_scale and v_scale")
     scale = q.shape[-1] ** -0.5 if scale is None else scale
     return _paged_call(q, k_pool, v_pool, block_table, lengths,
-                       scale=scale, interpret=interpret)
+                       scale=scale, interpret=interpret,
+                       k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_table, lengths, *,
-                           scale=None, interpret: bool = False):
+                           scale=None, interpret: bool = False,
+                           k_scale=None, v_scale=None):
     """Single-token decode specialization (S must be 1) — the serving
     hot path.  Thin wrapper so call sites (and probes) name the phase
     they are on; the grid/kernel body is shared with chunked prefill."""
@@ -198,23 +252,27 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths, *,
                          f"S={q.shape[2]} (use paged_prefill_attention)")
     return paged_attention_kernel(q, k_pool, v_pool, block_table,
                                   lengths, scale=scale,
-                                  interpret=interpret)
+                                  interpret=interpret,
+                                  k_scale=k_scale, v_scale=v_scale)
 
 
 def paged_prefill_attention(q, k_pool, v_pool, block_table, lengths, *,
-                            scale=None, interpret: bool = False):
+                            scale=None, interpret: bool = False,
+                            k_scale=None, v_scale=None):
     """Chunked-prefill variant: S = chunk queries per row at positions
     [lengths[b], lengths[b] + S), causal within the chunk and over the
     cache via the same visibility test (col <= q position)."""
     return paged_attention_kernel(q, k_pool, v_pool, block_table,
                                   lengths, scale=scale,
-                                  interpret=interpret)
+                                  interpret=interpret,
+                                  k_scale=k_scale, v_scale=v_scale)
 
 
 @functools.lru_cache(maxsize=16)
 def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
                      head_dim: int = 64, block_size: int = 16,
-                     prefill_chunk: int = 64) -> bool:
+                     prefill_chunk: int = 64,
+                     kv_dtype: str = "fp32") -> bool:
     """One-time probe per geometry: do the decode AND prefill kernels
     compile for this backend's Mosaic?  The serving dispatcher gates
     ``--serve-kernel auto`` on this (passing the dtype/heads/head_dim/
@@ -243,7 +301,13 @@ def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
             return False
         dt = jnp.dtype(dtype_name)
         B, NB, bs = 8, 4, block_size
-        pool = jnp.zeros((1 + B * NB, heads, bs, head_dim), dt)
+        # int8 mode swaps the pool storage for codes + scale siblings;
+        # Mosaic's int8 tiling rules differ from fp, so the probe must
+        # compile the exact variant the engine will dispatch
+        pool_dt = jnp.int8 if kv_dtype == "int8" else dt
+        pool = jnp.zeros((1 + B * NB, heads, bs, head_dim), pool_dt)
+        scales = (jnp.zeros((1 + B * NB, heads, bs), jnp.float32)
+                  if kv_dtype == "int8" else None)
         bt = jnp.arange(1, 1 + B * NB, dtype=jnp.int32).reshape(B, NB)
         lens = jnp.full((B,), bs, jnp.int32)
         chunks = []                       # 1 (decode) + pow2 buckets
@@ -253,7 +317,9 @@ def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
             S *= 2
         for S in chunks:
             q = jnp.zeros((B, heads, S, head_dim), dt)
-            jax.jit(paged_attention_kernel).lower(
+            jax.jit(functools.partial(
+                paged_attention_kernel,
+                k_scale=scales, v_scale=scales)).lower(
                 q, pool, pool, bt, lens).compile()
         return True
     except Exception as e:   # noqa: BLE001 — any compile failure disables

@@ -97,6 +97,21 @@ class ServeConfig:
                                   # so the zero-recompile contract is
                                   # untouched.  "off" drafts the full
                                   # configured k every step
+    kv_dtype: str = "fp32"        # pool storage format (--serve-kv-
+                                  # dtype): "fp32" keeps blocks in the
+                                  # model compute dtype — byte-for-byte
+                                  # the pre-quantization pool, the
+                                  # parity reference; "int8" stores
+                                  # symmetric-absmax codes with per-
+                                  # (block, head, slot) fp32 row scales
+                                  # (serving/paged_cache.init_pools):
+                                  # ~4x the tokens per pool byte, write
+                                  # paths quantize on store, consume
+                                  # paths dequantize in place (kernel:
+                                  # in register; XLA: on the gathered
+                                  # view), and greedy outputs track the
+                                  # fp32 pool at a token-match-rate
+                                  # gate rather than token identity
     tp: int = 1                   # tensor-parallel shards (--serve-tp):
                                   # >1 partitions the head-major pool,
                                   # QKV/O projections, and MLP over a
@@ -146,6 +161,7 @@ class ServeConfig:
                     speculative=config.serve_speculative,
                     draft_k=config.serve_draft_k,
                     draft_auto=config.serve_draft_auto,
+                    kv_dtype=config.serve_kv_dtype,
                     tp=config.serve_tp,
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
@@ -187,6 +203,9 @@ class ServeConfig:
                 "serve draft_auto tunes the speculative draft window; "
                 "with speculative off it would be silently ignored — "
                 "pick a drafter or drop it")
+        if self.kv_dtype not in ("fp32", "int8"):
+            raise ValueError(
+                f"serve kv dtype must be fp32|int8, got {self.kv_dtype!r}")
         if self.tp < 1:
             raise ValueError(f"serve tp must be >= 1, got {self.tp}")
         if (self.deadline_ms is not None and self.deadline_ms <= 0) \
@@ -263,11 +282,12 @@ class PagedDecodeEngine:
             model.cfg, heads=model.cfg.heads // serve.tp))
         self.kernel = paged_ops.resolve_kernel(
             serve.kernel, kcfg, serve.block_size,
-            serve.prefill_chunk)
+            serve.prefill_chunk, serve.kv_dtype)
         if self.tp_mesh is not None:
             self.params = tp_lib.shard_params(model, params, self.tp_mesh)
             self._paged_forward = tp_lib.make_paged_forward(
-                model, self.tp_mesh, self.kernel)
+                model, self.tp_mesh, self.kernel,
+                kv_dtype=serve.kv_dtype)
         else:
             self.params = params
             self._paged_forward = (
@@ -332,7 +352,8 @@ class PagedDecodeEngine:
         from mpi_tensorflow_tpu.serving import prefix_cache as prefix_lib
 
         self.pools = paged_cache.init_pools(
-            self.model.cfg, self.serve.num_blocks, self.serve.block_size)
+            self.model.cfg, self.serve.num_blocks, self.serve.block_size,
+            self.serve.kv_dtype)
         if self.tp_mesh is not None:
             # head-axis sharding (serving/tp): one block id addresses
             # the same slot of every shard's local-heads pool, so the
@@ -423,11 +444,12 @@ class PagedDecodeEngine:
         return nxt.astype(jnp.int32), pools
 
     def _cow_impl(self, pools, src, dst):
-        """Copy one pool block (all layers, K and V): the device half of
-        copy-on-write.  ``src``/``dst`` are traced scalars, so every
-        copy reuses the one compiled program."""
-        return [{"k": p["k"].at[dst].set(p["k"][src]),
-                 "v": p["v"].at[dst].set(p["v"][src])} for p in pools]
+        """Copy one pool block (all layers, K and V — and, under an int8
+        pool, the scale siblings riding the same leading block axis):
+        the device half of copy-on-write.  ``src``/``dst`` are traced
+        scalars, so every copy reuses the one compiled program."""
+        return [{key: leaf.at[dst].set(leaf[src])
+                 for key, leaf in p.items()} for p in pools]
 
     def _verify_impl(self, params, pools, tokens, lengths, n_valid,
                      tables):

@@ -119,12 +119,35 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
 
 
-def init_pools(cfg, num_blocks: int, block_size: int) -> list:
+def init_pools(cfg, num_blocks: int, block_size: int,
+               kv_dtype: str = "fp32") -> list:
     """Per-layer K/V block pools (zeros), mirroring the per-layer
     ``{"k", "v"}`` pytree shape of models/gpt.init_cache so the engine
-    threads them through jit the same way."""
+    threads them through jit the same way.
+
+    ``kv_dtype`` selects the pool storage format (--serve-kv-dtype):
+
+    - "fp32": blocks in the model compute dtype — byte-for-byte the
+      pre-quantization pool (the parity reference);
+    - "int8": blocks hold int8 codes, and each layer dict gains sibling
+      ``{"k_scale", "v_scale"}`` arrays of shape ``(num_blocks, heads,
+      block_size)`` fp32 — one symmetric-absmax scale per (block, head,
+      token-slot) row (ops/paged_attention.quantize_kv).  The scale
+      arrays share the pool's first two axes, so block-table indexing,
+      copy-on-write, and TP head-sharding treat them exactly like the
+      code arrays.
+    """
     import jax.numpy as jnp
 
+    if kv_dtype not in ("fp32", "int8"):
+        raise ValueError(
+            f"serve kv dtype must be fp32|int8, got {kv_dtype!r}")
+    if kv_dtype == "int8":
+        z = jnp.zeros((num_blocks, cfg.heads, block_size, cfg.head_dim),
+                      jnp.int8)
+        s = jnp.zeros((num_blocks, cfg.heads, block_size), jnp.float32)
+        return [{"k": z, "v": z, "k_scale": s, "v_scale": s}
+                for _ in range(cfg.layers)]
     z = jnp.zeros((num_blocks, cfg.heads, block_size, cfg.head_dim),
                   cfg.dtype)
     return [{"k": z, "v": z} for _ in range(cfg.layers)]

@@ -94,10 +94,15 @@ def param_specs(model, mesh: Mesh):
                                 rules_lib.SERVING_TP_RULES)
 
 
-def pool_specs(layers: int):
+def pool_specs(layers: int, kv_dtype: str = "fp32"):
     """PartitionSpec pytree for the per-layer K/V pools: the head axis
-    (axis 1 of ``(num_blocks, H, block_size, D)``) over ``tp``."""
+    (axis 1 of ``(num_blocks, H, block_size, D)``) over ``tp``.  An
+    int8 pool's scale siblings — ``(num_blocks, H, block_size)`` —
+    carry heads on the SAME axis 1, so one spec serves both leaves."""
     s = P(None, TP_AXIS)
+    if kv_dtype == "int8":
+        return [{"k": s, "v": s, "k_scale": s, "v_scale": s}
+                for _ in range(layers)]
     return [{"k": s, "v": s} for _ in range(layers)]
 
 
@@ -109,13 +114,15 @@ def shard_params(model, params, mesh: Mesh):
 
 def shard_pools(pools, mesh: Mesh):
     """Place freshly initialized (host-built) pools onto the mesh,
-    head-axis sharded."""
+    head-axis sharded — generic over the layer dict's leaves (codes and,
+    under int8, their scale siblings all put heads on axis 1)."""
     s = NamedSharding(mesh, P(None, TP_AXIS))
-    return [{"k": jax.device_put(p["k"], s), "v": jax.device_put(p["v"], s)}
+    return [{key: jax.device_put(leaf, s) for key, leaf in p.items()}
             for p in pools]
 
 
-def make_paged_forward(model, mesh: Mesh, kernel: str):
+def make_paged_forward(model, mesh: Mesh, kernel: str,
+                       kv_dtype: str = "fp32"):
     """The shard_map-wrapped ``forward_paged``: params and pools enter
     pre-sharded (heads/mlp/pool-head-axis over ``tp``), tokens / block
     tables / lengths / valid masks replicated.  Each shard runs the full
@@ -128,7 +135,7 @@ def make_paged_forward(model, mesh: Mesh, kernel: str):
     pools)``.
     """
     specs = param_specs(model, mesh)
-    pspec = pool_specs(model.cfg.layers)
+    pspec = pool_specs(model.cfg.layers, kv_dtype)
     rep = P()
 
     def inner(params, tokens, pools, tables, lengths, valid):

@@ -183,6 +183,54 @@ def speculation_block(counters, *, enabled: bool, mode: str = "off",
     }
 
 
+def kv_quant_block(*, kv_dtype: str = "fp32", matched_tokens: int = 0,
+                   compared_tokens: int = 0, block_bytes_ref: int = 0,
+                   block_bytes: int = 0, num_blocks: int = 0,
+                   peak_live_blocks_ref: int = 0,
+                   peak_live_blocks: int = 0,
+                   bytes_per_decode_token_ref: float = 0.0,
+                   bytes_per_decode_token: float = 0.0) -> dict:
+    """Normalize KV-quantization A/B numbers into the canonical serving
+    ``kv_quant`` block (bench --serve-kv-ab JSON) — same discipline as
+    the blocks above: every key present, plain types, rounding here.
+
+    ``*_ref`` is the fp32 (unquantized) arm.  ``token_match_rate`` is
+    positionwise greedy-token agreement between the arms over the whole
+    trace (aligned positions; length mismatches count as mismatches) —
+    the quality gate quantization must clear.  ``capacity_multiplier``
+    / ``effective_capacity_blocks`` answer the question the feature
+    exists for: how many pool blocks the SAME HBM budget holds at the
+    quantized bytes-per-block (codes + scale siblings).
+    ``peak_live_blocks_delta`` pins the arms' block-accounting
+    equivalence (same trace => same block walk => 0), and the
+    bytes-per-decode-token pair is the decode bandwidth roofline at the
+    quantized element width (1 byte/elem for int8, plus scale
+    traffic)."""
+    return {
+        "enabled": True,
+        "kv_dtype": kv_dtype,
+        "matched_tokens": int(matched_tokens),
+        "compared_tokens": int(compared_tokens),
+        "token_match_rate": (round(matched_tokens / compared_tokens, 4)
+                             if compared_tokens else 0.0),
+        "block_bytes_ref": int(block_bytes_ref),
+        "block_bytes": int(block_bytes),
+        "capacity_multiplier": (round(block_bytes_ref / block_bytes, 4)
+                                if block_bytes else 0.0),
+        "effective_capacity_blocks": (
+            int(num_blocks * block_bytes_ref // block_bytes)
+            if block_bytes else 0),
+        "num_blocks": int(num_blocks),
+        "peak_live_blocks_ref": int(peak_live_blocks_ref),
+        "peak_live_blocks": int(peak_live_blocks),
+        "peak_live_blocks_delta": int(peak_live_blocks
+                                      - peak_live_blocks_ref),
+        "bytes_per_decode_token_ref": round(
+            float(bytes_per_decode_token_ref), 2),
+        "bytes_per_decode_token": round(float(bytes_per_decode_token), 2),
+    }
+
+
 #: canonical goodput-under-SLO keys — THE shape of the ``goodput``
 #: block every consumer sees (bench.py --mode serving JSON, the metric
 #: line's goodput_tokens_per_sec / slo_attainment fields).  Goodput =

@@ -51,6 +51,12 @@
 #        T1_FILES="tests/test_loadgen.py tests/test_bench.py" \
 #            scripts/t1_guard.sh    # workload/goodput layer (loadgen is
 #                                   # host-only: seconds, no jax dispatch)
+#        T1_FILES="tests/test_paged_kernel.py tests/test_kv_quant.py" \
+#            scripts/t1_guard.sh    # int8 KV-quantization layer: parity
+#                                   # + error bounds (test_paged_kernel)
+#                                   # and the prefix/eviction/rollback/
+#                                   # replay composition pins
+#                                   # (test_kv_quant)
 
 set -u
 cd "$(dirname "$0")/.."

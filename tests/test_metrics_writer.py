@@ -116,6 +116,30 @@ class TestMetricsWriter:
         assert not z["enabled"] and z["slo_attainment"] == 0.0
         assert z["goodput_tokens_per_sec"] == 0.0 and z["per_tenant"] == {}
 
+    def test_kv_quant_block_normalizes_ab_numbers(self):
+        """The canonical KV-quantization A/B block: token-match rate,
+        effective-capacity multiplier from bytes-per-block, the
+        peak-live-blocks delta, and the decode-bandwidth roofline pair
+        — the one shape bench --serve-kv-ab JSON carries."""
+        block = metrics_writer.kv_quant_block(
+            kv_dtype="int8", matched_tokens=99, compared_tokens=100,
+            block_bytes_ref=4096, block_bytes=1280, num_blocks=25,
+            peak_live_blocks_ref=7, peak_live_blocks=7,
+            bytes_per_decode_token_ref=19136.834,
+            bytes_per_decode_token=5980.259)
+        assert block["enabled"] and block["kv_dtype"] == "int8"
+        assert block["token_match_rate"] == 0.99
+        assert block["capacity_multiplier"] == 3.2
+        assert block["effective_capacity_blocks"] == 80   # 25 * 4096//1280
+        assert block["peak_live_blocks_delta"] == 0
+        assert block["bytes_per_decode_token_ref"] == 19136.83
+        assert block["bytes_per_decode_token"] == 5980.26
+        # zero-safe: fp32-only run, nothing compared, no division blowups
+        z = metrics_writer.kv_quant_block()
+        assert z["token_match_rate"] == 0.0
+        assert z["capacity_multiplier"] == 0.0
+        assert z["effective_capacity_blocks"] == 0
+
     def test_write_faults_streams_one_scalar_per_counter(self, tmp_path):
         d = str(tmp_path / "m")
         with metrics_writer.MetricsWriter(d) as mw:
