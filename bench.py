@@ -626,7 +626,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         draft_k=draft_k, draft_auto=draft_auto, tp=tp,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
-    replicas = 1 if replicas is None else replicas
+    # resolve the unset knob through cfg like every other serve knob,
+    # instead of a hardcoded 1 that shadows cfg.serve_replicas
+    replicas = replicas if replicas is not None else cfg.serve_replicas
     if replicas < 1:
         raise ValueError(f"--serve-replicas must be >= 1, got {replicas}")
     if (fault_replica is None) != (fault_step is None):

@@ -1,0 +1,7 @@
+"""``python -m mpi_tensorflow_tpu.analysis`` entry point."""
+
+import sys
+
+from mpi_tensorflow_tpu.analysis.runner import main
+
+sys.exit(main())

@@ -1,0 +1,251 @@
+"""recompile-hazard pass: the zero-recompile contract, statically.
+
+The engine's dispatch discipline (engine.py: pow2-bucketed shapes, jit
+caches warmed at build) is pinned at RUNTIME by the
+``compile_counts()`` probes; this pass is the static complement — it
+catches the three ways a PR reintroduces steady-state recompiles
+before any test runs:
+
+- ``JIT-BRANCH`` — a Python ``if``/``while`` on a traced argument
+                   inside a function reachable from a ``jax.jit`` /
+                   ``shard_map`` call site.  Trace-time-static forms
+                   are exempt: ``is None`` pytree-structure checks,
+                   ``isinstance``, and ``.shape``/``.ndim``/``.dtype``/
+                   ``len()`` accesses (static under tracing).
+- ``JIT-LOOP``   — ``jax.jit``/``pjit``/``shard_map`` CONSTRUCTED
+                   inside a loop body: each iteration builds a fresh
+                   callable with a fresh cache.  Intentional compile
+                   probes allowlist with ``# graft-lint: jit-ok(...)``.
+- ``JIT-SHAPE``  — a dispatch-buffer shape (``np.zeros`` family) in
+                   ``serving/`` built from a raw ``len(...)`` instead
+                   of the pow2 bucket helpers (``pow2_ceil`` /
+                   ``_bucket`` in serving/engine.py): request-length-
+                   dependent shapes compile once per distinct length.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set
+
+from mpi_tensorflow_tpu.analysis import core
+
+PASS_IDS = ("JIT-BRANCH", "JIT-LOOP", "JIT-SHAPE")
+
+JIT_CTORS = {"jax.jit", "jit", "pjit", "jax.pjit"}
+SHARD_CTORS = {"jax.shard_map", "shard_map",
+               "jax.experimental.shard_map.shard_map"}
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding"}
+STATIC_CALLS = {"isinstance", "len", "type"}
+ARRAY_CTORS = {"zeros", "ones", "empty", "full"}
+ARRAY_MODULES = {"np", "jnp", "numpy", "onp"}
+
+
+def _parents(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    out: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            out[child] = node
+    return out
+
+
+def _enclosing_class(node: ast.AST, parents) -> Optional[ast.ClassDef]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _resolve(expr: ast.AST, tree: ast.Module, parents,
+             site: ast.AST, depth: int = 0) -> Optional[ast.AST]:
+    """Best-effort resolution of a jit/shard_map first argument to a
+    function definition in the same module (one assignment /
+    ``functools.partial`` / ``shard_map`` hop deep)."""
+    if depth > 4:
+        return None
+    if isinstance(expr, ast.Lambda):
+        return expr
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value,
+                                                      ast.Name) \
+            and expr.value.id == "self":
+        cls = _enclosing_class(site, parents)
+        if cls is not None:
+            return core.find_function(cls, expr.attr)
+        return None
+    if isinstance(expr, ast.Call):
+        name = core.dotted_name(expr.func)
+        if name in (JIT_CTORS | SHARD_CTORS
+                    | {"functools.partial", "partial"}) and expr.args:
+            return _resolve(expr.args[0], tree, parents, site, depth + 1)
+        return None
+    if isinstance(expr, ast.Name):
+        fn = core.find_function(tree, expr.id)
+        if fn is not None:
+            return fn
+        # name = jax.shard_map(f, ...) / functools.partial(f, ...)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == expr.id
+                            for t in node.targets):
+                return _resolve(node.value, tree, parents, site,
+                                depth + 1)
+    return None
+
+
+def _jit_roots(tree: ast.Module, parents) -> Iterable[ast.AST]:
+    """Function definitions reachable from jit/shard_map call sites or
+    carrying a jit decorator."""
+    seen: Set[int] = set()
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Call) \
+                and core.dotted_name(node.func) in (JIT_CTORS
+                                                    | SHARD_CTORS) \
+                and node.args:
+            target = _resolve(node.args[0], tree, parents, node)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                base = dec.func if isinstance(dec, ast.Call) else dec
+                if core.dotted_name(base) in JIT_CTORS:
+                    target = node
+        if target is not None and id(target) not in seen:
+            seen.add(id(target))
+            yield target
+
+
+def _branch_sites(fn: ast.AST):
+    """Yield ``(branch_node, traced_names)`` where the traced set is
+    the params of the branch's lexical ANCESTOR functions (the jit root
+    plus closure-capturing nested defs — ``lax.scan`` bodies etc.).
+    Sibling/descendant defs are excluded: a param name in a nested def
+    shadows only its own body, and counting it at the outer branch
+    false-positives on closure-captured static config values."""
+
+    def visit(node: ast.AST, scope: Set[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            scope = scope | set(core.arg_names(node))
+        if isinstance(node, (ast.If, ast.While)):
+            yield node, scope
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, scope)
+
+    yield from visit(fn, set())
+
+
+def _value_branches(test: ast.AST, traced: Set[str]) -> List[str]:
+    """Traced names whose Python VALUE the test depends on, skipping
+    trace-time-static subexpressions."""
+    hits: List[str] = []
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, ast.Attribute) \
+                and node.attr in STATIC_ATTRS:
+            return                      # x.shape / x.dtype: static
+        if isinstance(node, ast.Call) \
+                and core.dotted_name(node.func) in STATIC_CALLS:
+            return                      # isinstance/len/type: static
+        if isinstance(node, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in node.ops):
+            return                      # `x is None`: pytree structure
+        if isinstance(node, ast.Name) \
+                and isinstance(node.ctx, ast.Load) \
+                and node.id in traced:
+            hits.append(node.id)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    visit(test)
+    return hits
+
+
+def _len_bound_names(fn: ast.AST) -> Set[str]:
+    """Names assigned from a bare ``len(...)`` in this function."""
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) \
+                and isinstance(node.value, ast.Call) \
+                and core.dotted_name(node.value.func) == "len":
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.add(t.id)
+    return out
+
+
+def run(sources: Dict[str, str]) -> List[core.Finding]:
+    findings: List[core.Finding] = []
+    trees = core.parse_sources(sources)
+    for rel, tree in trees.items():
+        src = sources[rel]
+        parents = _parents(tree)
+
+        # --- JIT-BRANCH: value branching inside jit-reachable fns ---
+        for fn in _jit_roots(tree, parents):
+            for node, traced in _branch_sites(fn):
+                for name in sorted(set(_value_branches(node.test,
+                                                       traced))):
+                    if core.allowlist_reason(src, node.lineno, "jit"):
+                        continue
+                    findings.append(core.Finding(
+                        rel, node.lineno, "JIT-BRANCH",
+                        f"branch on traced argument {name!r} inside a "
+                        f"jitted function (recompiles per Python "
+                        f"value; hoist or use lax.cond/jnp.where)"))
+
+        # --- JIT-LOOP: jit construction inside loop bodies ---
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.For, ast.While)):
+                continue
+            for sub in ast.walk(node):
+                if sub is node:
+                    continue
+                if isinstance(sub, ast.Call) \
+                        and core.dotted_name(sub.func) in (JIT_CTORS
+                                                           | SHARD_CTORS):
+                    if core.allowlist_reason(src, sub.lineno, "jit"):
+                        continue
+                    findings.append(core.Finding(
+                        rel, sub.lineno, "JIT-LOOP",
+                        f"{core.dotted_name(sub.func)} constructed "
+                        f"inside a loop body: every iteration builds "
+                        f"a fresh callable with an empty compile "
+                        f"cache"))
+
+        # --- JIT-SHAPE: unbucketed dispatch shapes in serving/ ---
+        if "serving/" not in rel:
+            continue
+        for fn in core.iter_functions(tree):
+            len_names = _len_bound_names(fn)
+            for node in ast.walk(fn):
+                if not (isinstance(node, ast.Call) and node.args):
+                    continue
+                name = core.dotted_name(node.func)
+                if name is None or "." not in name:
+                    continue
+                mod, _, ctor = name.rpartition(".")
+                if ctor not in ARRAY_CTORS \
+                        or mod.split(".")[0] not in ARRAY_MODULES:
+                    continue
+                shape = node.args[0]
+                elts = (shape.elts if isinstance(shape, ast.Tuple)
+                        else [shape])
+                for el in elts:
+                    raw = (isinstance(el, ast.Call)
+                           and core.dotted_name(el.func) == "len") \
+                        or (isinstance(el, ast.Name)
+                            and el.id in len_names)
+                    if not raw:
+                        continue
+                    if core.allowlist_reason(src, node.lineno, "jit"):
+                        continue
+                    findings.append(core.Finding(
+                        rel, node.lineno, "JIT-SHAPE",
+                        f"dispatch buffer shaped by a raw length in "
+                        f"{name}: route it through the pow2 bucket "
+                        f"helpers (engine.pow2_ceil/_bucket) or the "
+                        f"shape recompiles per distinct length"))
+    return findings

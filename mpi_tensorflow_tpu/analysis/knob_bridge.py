@@ -1,0 +1,270 @@
+"""knob-bridge pass: the ``--serve-*`` CLI surface must bridge.
+
+Every serve knob crosses three layers — argparse flag, Config field,
+downstream consumer (``ServeConfig.from_config`` / ``WorkloadSpec`` /
+the router) — and CHANGES.md has hand-checked that bridge in every PR
+since the serving engine landed.  This pass mechanizes it:
+
+- ``KNOB-FLAG``  — a ``--serve-*`` flag with no Config field, a flag
+                   parsed but never wired through ``config_from_args``,
+                   or a ``serve_*`` Config field with no flag.
+- ``KNOB-GUARD`` — a knob missing validation at any of the three
+                   layers: argparse (``choices=`` or ``type=``), the
+                   fail-fast guards in ``cli.main``, and the downstream
+                   consumer's ``__post_init__`` (or the router's
+                   constructor guard for the fleet size).
+- ``KNOB-DEAD``  — a ``serve_*`` Config field nothing ever reads.
+
+The pass discovers files by content (the module defining
+``build_parser``, the ``Config`` / ``ServeConfig`` / ``WorkloadSpec`` /
+``ReplicaRouter`` classes), so fixture trees exercise the same code.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from mpi_tensorflow_tpu.analysis import core
+
+PASS_IDS = ("KNOB-FLAG", "KNOB-GUARD", "KNOB-DEAD")
+
+#: serve knobs whose downstream validation layer is NOT
+#: ``ServeConfig.__post_init__`` (they never enter ``from_config``):
+#: field -> (consumer class, validated attr on it, or None meaning
+#: "constructor must guard by raising").  Keep this table current —
+#: a serve field in neither ``from_config`` nor here is itself a
+#: KNOB-GUARD finding.
+EXTRA_BRIDGES: Dict[str, Tuple[str, Optional[str]]] = {
+    "serve_workload": ("WorkloadSpec", "workload"),
+    "serve_slo_ms": ("WorkloadSpec", "slo_ms"),
+    "serve_replicas": ("ReplicaRouter", None),
+}
+
+
+def _find_cli(trees: Dict[str, ast.Module]) -> Optional[Tuple[str,
+                                                              ast.Module]]:
+    for rel, tree in trees.items():
+        if core.find_function(tree, "build_parser") is not None \
+                and core.find_function(tree, "main") is not None:
+            return rel, tree
+    return None
+
+
+def _serve_flags(cli_tree: ast.Module) -> Dict[str, dict]:
+    """``dest -> {flag, line, kwargs}`` for every --serve-* flag."""
+    parser_fn = core.find_function(cli_tree, "build_parser")
+    flags: Dict[str, dict] = {}
+    for node in ast.walk(parser_fn):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "add_argument" and node.args):
+            continue
+        first = node.args[0]
+        if not (isinstance(first, ast.Constant)
+                and isinstance(first.value, str)
+                and first.value.startswith("--serve-")):
+            continue
+        dest = first.value[2:].replace("-", "_")
+        flags[dest] = {
+            "flag": first.value,
+            "line": node.lineno,
+            "kwargs": {kw.arg for kw in node.keywords if kw.arg},
+        }
+    return flags
+
+
+def _config_fields(trees) -> Optional[Tuple[str, Dict[str, int]]]:
+    loc = core.find_class(trees, "Config")
+    if loc is None:
+        return None
+    rel, cls = loc
+    fields = {}
+    for node in cls.body:
+        if isinstance(node, ast.AnnAssign) and isinstance(node.target,
+                                                          ast.Name):
+            fields[node.target.id] = node.lineno
+    return rel, fields
+
+
+def _wired_kwargs(cli_tree: ast.Module) -> Set[str]:
+    """Keyword names passed to ``Config(...)`` in ``config_from_args``."""
+    fn = core.find_function(cli_tree, "config_from_args")
+    if fn is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) \
+                and core.dotted_name(node.func) == "Config":
+            out |= {kw.arg for kw in node.keywords if kw.arg}
+    return out
+
+
+def _main_guarded(cli_tree: ast.Module) -> Set[str]:
+    """``serve_*`` attrs referenced inside ``if`` tests in ``main`` —
+    the fail-fast guard layer."""
+    fn = core.find_function(cli_tree, "main")
+    out: Set[str] = set()
+    if fn is None:
+        return out
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Attribute) \
+                        and sub.attr.startswith("serve_"):
+                    out.add(sub.attr)
+    return out
+
+
+def _from_config_map(trees) -> Dict[str, str]:
+    """``serve_* Config field -> ServeConfig field`` parsed from the
+    ``from_config`` bridge (the keyword mapping is THE bridge — parsing
+    it rather than hardcoding it is the point of this pass)."""
+    loc = core.find_class(trees, "ServeConfig")
+    if loc is None:
+        return {}
+    _rel, cls = loc
+    fn = core.find_function(cls, "from_config")
+    if fn is None:
+        return {}
+    mapping: Dict[str, str] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            for kw in node.keywords:
+                if kw.arg and isinstance(kw.value, ast.Attribute) \
+                        and kw.value.attr.startswith("serve_"):
+                    mapping[kw.value.attr] = kw.arg
+    return mapping
+
+
+def _post_init_validated(trees, class_name: str) -> Optional[Set[str]]:
+    """Attrs referenced in ``if`` tests inside ``__post_init__`` of
+    ``class_name`` (``self.x`` or bare dataclass-field names)."""
+    loc = core.find_class(trees, class_name)
+    if loc is None:
+        return None
+    _rel, cls = loc
+    fn = core.find_function(cls, "__post_init__")
+    if fn is None:
+        return set()
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If):
+            for sub in ast.walk(node.test):
+                if isinstance(sub, ast.Attribute):
+                    out.add(sub.attr)
+                elif isinstance(sub, ast.Name):
+                    out.add(sub.id)
+    return out
+
+
+def _ctor_raises(trees, class_name: str) -> bool:
+    loc = core.find_class(trees, class_name)
+    if loc is None:
+        return False
+    _rel, cls = loc
+    fn = core.find_function(cls, "__init__")
+    return fn is not None and any(isinstance(n, ast.Raise)
+                                  for n in ast.walk(fn))
+
+
+def _consumed_fields(trees, skip_files: Set[str]) -> Set[str]:
+    """Every ``.serve_*`` attribute READ outside the cli/config
+    modules (bench.py resolves unset bench knobs through them; the
+    ``from_config`` bridge is counted separately)."""
+    out: Set[str] = set()
+    for rel, tree in trees.items():
+        if rel in skip_files:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and node.attr.startswith("serve_"):
+                out.add(node.attr)
+    return out
+
+
+def run(sources: Dict[str, str]) -> List[core.Finding]:
+    trees = core.parse_sources(sources)
+    cli = _find_cli(trees)
+    cfg = _config_fields(trees)
+    if cli is None or cfg is None:
+        return []           # not a tree this pass applies to
+    cli_rel, cli_tree = cli
+    cfg_rel, fields = cfg
+    serve_fields = {k: v for k, v in fields.items()
+                    if k.startswith("serve_")}
+    flags = _serve_flags(cli_tree)
+    wired = _wired_kwargs(cli_tree)
+    guarded = _main_guarded(cli_tree)
+    bridge = _from_config_map(trees)
+    serve_cfg_validated = _post_init_validated(trees, "ServeConfig")
+    findings: List[core.Finding] = []
+
+    def add(pass_id, file, line, msg):
+        findings.append(core.Finding(file, line, pass_id, msg))
+
+    # --- flag <-> field <-> construction wiring ---
+    for dest, info in flags.items():
+        if dest not in fields:
+            add("KNOB-FLAG", cli_rel, info["line"],
+                f"{info['flag']} has no Config field {dest!r}")
+        if dest not in wired:
+            add("KNOB-FLAG", cli_rel, info["line"],
+                f"{info['flag']} parsed but never wired into Config "
+                f"(config_from_args drops it)")
+    for field, line in serve_fields.items():
+        if field not in flags:
+            add("KNOB-FLAG", cfg_rel, line,
+                f"Config.{field} has no --serve-* flag")
+
+    # --- three-layer validation ---
+    main_fn = core.find_function(cli_tree, "main")
+    main_line = main_fn.lineno if main_fn else 1
+    for field, line in serve_fields.items():
+        info = flags.get(field)
+        if info is not None and not ({"choices", "type"}
+                                     & info["kwargs"]):
+            add("KNOB-GUARD", cli_rel, info["line"],
+                f"{info['flag']} has no argparse-level validation "
+                f"(neither choices= nor type=)")
+        if field not in guarded:
+            add("KNOB-GUARD", cli_rel, main_line,
+                f"Config.{field} has no cli.main guard (programmatic "
+                f"Config construction bypasses argparse choices)")
+        # downstream layer
+        if field in bridge:
+            target = bridge[field]
+            if serve_cfg_validated is not None \
+                    and target not in serve_cfg_validated:
+                add("KNOB-GUARD", cfg_rel, line,
+                    f"Config.{field} maps to ServeConfig.{target}, "
+                    f"which ServeConfig.__post_init__ never validates")
+        elif field in EXTRA_BRIDGES:
+            cls_name, attr = EXTRA_BRIDGES[field]
+            if attr is None:
+                if not _ctor_raises(trees, cls_name):
+                    add("KNOB-GUARD", cfg_rel, line,
+                        f"Config.{field}: {cls_name} constructor has "
+                        f"no guard (expected a raising check)")
+            else:
+                validated = _post_init_validated(trees, cls_name)
+                if validated is not None and attr not in validated:
+                    add("KNOB-GUARD", cfg_rel, line,
+                        f"Config.{field} maps to {cls_name}.{attr}, "
+                        f"which its __post_init__ never validates")
+        else:
+            add("KNOB-GUARD", cfg_rel, line,
+                f"Config.{field} reaches neither ServeConfig."
+                f"from_config nor the EXTRA_BRIDGES table — no "
+                f"downstream validation layer is checked")
+
+    # --- dead fields ---
+    consumed = _consumed_fields(trees, skip_files={cli_rel, cfg_rel})
+    for field, line in serve_fields.items():
+        if field not in bridge and field not in consumed:
+            add("KNOB-DEAD", cfg_rel, line,
+                f"Config.{field} is never consumed (not in "
+                f"ServeConfig.from_config, never read outside "
+                f"cli/config)")
+    return findings

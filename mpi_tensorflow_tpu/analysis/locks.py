@@ -1,0 +1,124 @@
+"""lock-discipline pass: ``_GUARDED_BY`` declarations, enforced.
+
+PR 7 shipped a real KeyError race: the router's sticky-map read,
+health check, and LRU touch spanned two lock holds, so a concurrent
+trim could evict the key between them.  The fix was "do it under ONE
+lock hold" — a convention this pass turns into a checked contract.
+
+A class declares its threading discipline in one class attribute::
+
+    class ReplicaRouter:
+        _GUARDED_BY = {"_lock": ("_sticky", "_session_live", ...)}
+
+and the pass proves, lexically, that EVERY read or write of
+``self.<guarded attr>`` anywhere in the class sits inside a
+``with self._lock`` block.  Escapes:
+
+- ``__init__`` (no concurrent access before construction returns);
+- methods named ``*_locked`` (the caller-holds-the-lock convention —
+  their call sites are checked instead, since those sit in lock-held
+  ``with`` blocks);
+- ``# graft-lint: lock-ok(<reason>)`` on the line or the line above,
+  for provably single-threaded phases (cold init, post-join
+  aggregation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from mpi_tensorflow_tpu.analysis import core
+
+PASS_IDS = ("LOCK-HELD",)
+
+
+def _guarded_map(cls: ast.ClassDef) -> Dict[str, Set[str]]:
+    """Parse the ``_GUARDED_BY`` literal: lock attr -> guarded attrs."""
+    for node in cls.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+        if not any(isinstance(t, ast.Name) and t.id == "_GUARDED_BY"
+                   for t in targets):
+            continue
+        try:
+            raw = ast.literal_eval(node.value)
+        except (ValueError, SyntaxError):
+            return {}
+        return {lock: set(attrs) for lock, attrs in raw.items()}
+    return {}
+
+
+def _under_lock(node: ast.AST, lock: str,
+                parents: Dict[ast.AST, ast.AST],
+                stop: ast.AST) -> bool:
+    """Is ``node`` lexically inside ``with self.<lock>`` (climbing no
+    higher than ``stop``, the class body)?"""
+    cur = parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Attribute) and ctx.attr == lock \
+                        and isinstance(ctx.value, ast.Name) \
+                        and ctx.value.id == "self":
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+def _enclosing_method(node: ast.AST, parents,
+                      cls: ast.ClassDef) -> Optional[ast.FunctionDef]:
+    """The class-level method containing ``node`` (not nested defs)."""
+    method = None
+    cur = parents.get(node)
+    while cur is not None and cur is not cls:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and parents.get(cur) is cls:
+            method = cur
+        cur = parents.get(cur)
+    return method
+
+
+def run(sources: Dict[str, str]) -> List[core.Finding]:
+    findings: List[core.Finding] = []
+    trees = core.parse_sources(sources)
+    for rel, tree in trees.items():
+        src = sources[rel]
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for cls in ast.walk(tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            guarded = _guarded_map(cls)
+            if not guarded:
+                continue
+            for lock, attrs in guarded.items():
+                for node in ast.walk(cls):
+                    if not (isinstance(node, ast.Attribute)
+                            and node.attr in attrs
+                            and isinstance(node.value, ast.Name)
+                            and node.value.id == "self"):
+                        continue
+                    method = _enclosing_method(node, parents, cls)
+                    if method is not None \
+                            and (method.name == "__init__"
+                                 or method.name.endswith("_locked")):
+                        continue
+                    if _under_lock(node, lock, parents, cls):
+                        continue
+                    if core.allowlist_reason(src, node.lineno, "lock"):
+                        continue
+                    where = method.name if method is not None \
+                        else cls.name
+                    findings.append(core.Finding(
+                        rel, node.lineno, "LOCK-HELD",
+                        f"self.{node.attr} accessed in {where} outside "
+                        f"`with self.{lock}` (declared _GUARDED_BY; "
+                        f"the PR 7 sticky-map race class)"))
+    return findings

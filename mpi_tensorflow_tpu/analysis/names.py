@@ -1,0 +1,148 @@
+"""names pass: undefined names and unused imports, pyflakes-style.
+
+The reference repo class of bug this kills: an exception handler
+referencing a ``DownloadError`` that was never imported — dead until
+the one rainy day it runs, then a ``NameError`` on top of the real
+failure.  The check is deliberately conservative (one flat binding
+scope per module: every Store/def/import/arg anywhere counts), so it
+can miss cross-scope mistakes but cannot false-positive on forward
+references or method-order tricks.
+
+- ``NAMES-UNDEF``  — a loaded name bound nowhere in the module and not
+                     a builtin.
+- ``NAMES-IMPORT`` — an import binding no code in the module loads
+                     (``__init__.py`` re-export surfaces are skipped;
+                     ``# noqa`` or ``# graft-lint: name-ok(...)`` on
+                     the import line opts out).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Set, Tuple
+
+from mpi_tensorflow_tpu.analysis import core
+
+PASS_IDS = ("NAMES-UNDEF", "NAMES-IMPORT")
+
+_BUILTINS = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__builtins__", "__debug__", "__class__", "__loader__",
+}
+
+
+def _module_bindings(tree: ast.Module) -> Set[str]:
+    bound: Set[str] = set()
+    star_import = False
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            bound.add(node.name)
+            bound |= set(core.arg_names(node)) \
+                if not isinstance(node, ast.ClassDef) else set()
+            if not isinstance(node, ast.ClassDef):
+                bound |= {"self", "cls"}
+        elif isinstance(node, ast.Lambda):
+            bound |= set(core.arg_names(node)) | {"self", "cls"}
+        elif isinstance(node, ast.Import):
+            for alias in node.names:
+                bound.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    star_import = True
+                else:
+                    bound.add(alias.asname or alias.name)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            bound.add(node.name)
+        elif isinstance(node, ast.Global):
+            bound |= set(node.names)
+        elif isinstance(node, ast.Nonlocal):
+            bound |= set(node.names)
+    if star_import:
+        bound.add("*")
+    return bound
+
+
+def _loads(tree: ast.Module) -> List[Tuple[str, int]]:
+    return [(n.id, n.lineno) for n in ast.walk(tree)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+
+
+def _dunder_all(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "__all__"
+                        for t in node.targets) \
+                and isinstance(node.value, (ast.List, ast.Tuple)):
+            out |= {e.value for e in node.value.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, str)}
+    return out
+
+
+def _line_opts_out(src_lines: List[str], lineno: int) -> bool:
+    if not 1 <= lineno <= len(src_lines):
+        return False
+    line = src_lines[lineno - 1]
+    return "noqa" in line or "graft-lint: name-ok(" in line
+
+
+def run(sources: Dict[str, str]) -> List[core.Finding]:
+    findings: List[core.Finding] = []
+    trees = core.parse_sources(sources)
+    for rel, tree in trees.items():
+        src_lines = sources[rel].splitlines()
+        bound = _module_bindings(tree)
+        loads = _loads(tree)
+        loaded_names = {n for n, _ in loads}
+        exported = _dunder_all(tree)
+
+        # --- undefined names (skip under a star import: bindings
+        #     unknown) ---
+        if "*" not in bound:
+            seen: Set[Tuple[str, int]] = set()
+            for name, lineno in loads:
+                if name in bound or name in _BUILTINS:
+                    continue
+                if (name, lineno) in seen \
+                        or _line_opts_out(src_lines, lineno):
+                    continue
+                seen.add((name, lineno))
+                findings.append(core.Finding(
+                    rel, lineno, "NAMES-UNDEF",
+                    f"name {name!r} is loaded but bound nowhere in "
+                    f"this module (NameError waiting for this path "
+                    f"to run)"))
+
+        # --- unused imports (re-export surfaces excluded) ---
+        if rel.endswith("__init__.py"):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                binding = alias.asname or alias.name.split(".")[0]
+                if isinstance(node, ast.ImportFrom) \
+                        and alias.asname is None:
+                    binding = alias.name
+                if binding in loaded_names or binding in exported:
+                    continue
+                line = getattr(alias, "lineno", node.lineno)
+                if _line_opts_out(src_lines, line):
+                    continue
+                findings.append(core.Finding(
+                    rel, line, "NAMES-IMPORT",
+                    f"import {binding!r} is never used in this "
+                    f"module"))
+    return findings

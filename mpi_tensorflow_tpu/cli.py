@@ -367,6 +367,12 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"bad --serve-prefix-cache {config.serve_prefix_cache!r}: "
             f"must be off|on")
+    if config.serve_kernel not in ("auto", "xla", "pallas"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-kernel {config.serve_kernel!r}: "
+            f"must be auto|xla|pallas")
     if config.serve_speculative not in ("off", "ngram", "draft-model") \
             or config.serve_draft_k < 1:
         raise SystemExit(

@@ -15,7 +15,7 @@ Semantics preserved from the reference:
 
 from __future__ import annotations
 
-from typing import Iterable, Sequence
+from typing import Iterable
 
 import numpy as np
 

@@ -9,7 +9,6 @@ statistics carried in the framework's ``model_state`` pytree.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

@@ -317,6 +317,7 @@ def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
             S *= 2
         for S in chunks:
             q = jnp.zeros((B, heads, S, head_dim), dt)
+            # graft-lint: jit-ok(compile probe: runs once at kernel resolve, not per step)
             jax.jit(functools.partial(
                 paged_attention_kernel,
                 k_scale=scales, v_scale=scales)).lower(

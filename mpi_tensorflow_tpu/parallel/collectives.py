@@ -25,7 +25,6 @@ rather than scattering raw ``lax`` calls through the codebase.
 
 from __future__ import annotations
 
-import jax
 import jax.numpy as jnp
 from jax import lax
 

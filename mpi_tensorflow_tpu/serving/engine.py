@@ -606,7 +606,7 @@ class PagedDecodeEngine:
         # the prompt's last position already yields the first output
         # token (exactly generate()'s prefill-argmax), so the slot
         # enters the decode pool one token ahead
-        tok = int(nxt)
+        tok = int(nxt)  # graft-lint: sync-ok(one scalar per admission, not per step)
         self._last_token[slot] = tok
         if self._journal is not None:
             self._journal.record_token(seq.request.id, tok)
@@ -673,7 +673,7 @@ class PagedDecodeEngine:
         nxt, self.pools = self._decode_fn(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(tables))
-        nxt = np.asarray(nxt)
+        nxt = np.asarray(nxt)  # graft-lint: sync-ok(the one budgeted bulk sync per decode dispatch)
         for j, slot in enumerate(live):
             tok = int(nxt[j])
             self._last_token[slot] = tok
@@ -778,7 +778,7 @@ class PagedDecodeEngine:
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(n_valid),
             jnp.asarray(tables))
-        out = np.asarray(out)
+        out = np.asarray(out)  # graft-lint: sync-ok(the one budgeted bulk sync per verify dispatch)
 
         counters = self.sched.counters
         for j, slot in enumerate(live):

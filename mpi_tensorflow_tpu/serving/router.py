@@ -164,6 +164,12 @@ class ReplicaRouter:
     state — a fresh fleet for a fresh trace replay.
     """
 
+    #: lock discipline, machine-checked by graft-lint's LOCK-HELD pass:
+    #: every access to these attrs must sit inside `with self._lock`
+    #: (the PR 7 sticky-map race class — see docs/ANALYSIS.md)
+    _GUARDED_BY = {"_lock": ("_sticky", "_session_live", "_outstanding",
+                             "fleet_counters", "_drain_counts")}
+
     def __init__(self, engines: List, *, make_engine=None,
                  probe_ticks: int = 4, max_sticky: int = 1024):
         if not engines:
@@ -186,11 +192,14 @@ class ReplicaRouter:
     def _cold_state(self) -> None:
         """Fresh fleet state (construction + ``reset``)."""
         n = len(self.engines)
+        # graft-lint: lock-ok(cold init: no worker threads exist yet)
         self._sticky: OrderedDict = OrderedDict()   # session -> replica
+        # graft-lint: lock-ok(cold init: no worker threads exist yet)
         self._session_live: Counter = Counter()     # session -> live reqs
         self.placements: Dict[int, int] = {}        # request id -> replica
         self._routed = [0] * n
         self.health = [ReplicaHealth() for _ in range(n)]
+        # graft-lint: lock-ok(cold init: no worker threads exist yet)
         self.fleet_counters: Counter = Counter()
         self._last_error: Optional[BaseException] = None
 
@@ -306,15 +315,20 @@ class ReplicaRouter:
         from mpi_tensorflow_tpu.utils.metrics_writer import \
             fleet_faults_block
 
-        return {
-            "sticky_sessions": len(self._sticky),
-            "sticky_live_sessions": len(self._session_live),
-            "sticky_capacity": self.max_sticky,
-            "sticky_rehomed": int(self.fleet_counters["sticky_rehomed"]),
-            "sticky_evicted": int(self.fleet_counters["sticky_evicted"]),
-            "health": [dataclasses.asdict(h) for h in self.health],
-            "fleet_faults": fleet_faults_block(self.fleet_counters),
-        }
+        # one lock hold for the whole snapshot: stats() is callable
+        # mid-run, and an unlocked read races the workers' updates
+        with self._lock:
+            return {
+                "sticky_sessions": len(self._sticky),
+                "sticky_live_sessions": len(self._session_live),
+                "sticky_capacity": self.max_sticky,
+                "sticky_rehomed":
+                    int(self.fleet_counters["sticky_rehomed"]),
+                "sticky_evicted":
+                    int(self.fleet_counters["sticky_evicted"]),
+                "health": [dataclasses.asdict(h) for h in self.health],
+                "fleet_faults": fleet_faults_block(self.fleet_counters),
+            }
 
     # ---------------- replica binding / failover ----------------
 
@@ -392,6 +406,7 @@ class ReplicaRouter:
         with self._lock:
             live = [rid for rid, ent in journal.entries.items()
                     if ent.status is None and rid in self._outstanding]
+        replay_tokens = 0
         for rid in sorted(live):
             req = self._requests_by_id.get(rid)
             if req is None:
@@ -416,9 +431,13 @@ class ReplicaRouter:
             # (terminal status wins, else longest delivered).
             journal.entries.pop(rid, None)
             self._pre[rid] = done
-            self.fleet_counters["replay_tokens"] += len(rep.prompt)
+            replay_tokens += len(rep.prompt)
             moved.append(rep)
-        self.fleet_counters["migrated_requests"] += len(moved)
+        # surviving workers bump fleet_counters under the lock; the
+        # failover path must too or the += read-modify-write races them
+        with self._lock:
+            self.fleet_counters["replay_tokens"] += replay_tokens
+            self.fleet_counters["migrated_requests"] += len(moved)
         if moved:
             self._pending = sorted(self._pending + moved,
                                    key=lambda r: r.arrival)
@@ -540,6 +559,7 @@ class ReplicaRouter:
         self._fault_plan = fault_plan
         self._pre = dict(replay_pre or {})
         self._requests_by_id = {r.id: r for r in requests}
+        # graft-lint: lock-ok(run setup: worker threads not started yet)
         self._outstanding = set(self._requests_by_id)
         self._pending = sorted(requests, key=lambda r: r.arrival)
         self._inboxes = [deque() for _ in range(n)]
@@ -554,6 +574,7 @@ class ReplicaRouter:
         self._counter_snap = [Counter() for _ in range(n)]
         self._evict_snap = [0] * n
         self._drain = DrainTracker(self.engines[0].serve.drain_ms)
+        # graft-lint: lock-ok(run setup: worker threads not started yet)
         self._drain_counts: Counter = Counter()
         self._drain_shed_done = [False] * n
         self._abort_req = [False] * n
@@ -805,18 +826,24 @@ class ReplicaRouter:
                     finish[rid] = max(finish.get(rid, t), t)
         lat = np.asarray(flat) if flat else np.zeros(1)
         total = sum(len(v) for v in outputs.values())
-        drain = self._drain.result_counts(self._drain_counts)
+        # workers are joined, but late probe/failover stragglers may
+        # still hold references: snapshot the shared state in one hold
+        with self._lock:
+            fleet_counters = Counter(self.fleet_counters)
+            drain_counts = Counter(self._drain_counts)
+            sticky_n = len(self._sticky)
+        drain = self._drain.result_counts(drain_counts)
         return {
             "parallel": parallel,
             "outputs": outputs,
             "statuses": statuses,
             "faults": faults_block(totals),
-            "fleet_faults": fleet_faults_block(self.fleet_counters),
+            "fleet_faults": fleet_faults_block(fleet_counters),
             "drain": drain,
             "health": [h.state for h in self.health],
             "replicas": per_replica,
             "num_replicas": len(self.engines),
-            "sticky_sessions": len(self._sticky),
+            "sticky_sessions": sticky_n,
             "placements": dict(self.placements),
             "tokens": total,
             "elapsed_s": elapsed,

@@ -16,7 +16,6 @@ shape from a single chip to a pod slice.
 
 from __future__ import annotations
 
-import functools
 from typing import Any, NamedTuple, Optional
 
 import jax

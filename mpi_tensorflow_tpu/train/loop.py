@@ -23,7 +23,6 @@ import dataclasses
 from typing import Any, Optional
 
 import jax
-import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from mpi_tensorflow_tpu.config import Config

@@ -23,7 +23,6 @@ Two synchronization strategies:
 
 from __future__ import annotations
 
-import functools
 from typing import Any, NamedTuple
 
 import jax

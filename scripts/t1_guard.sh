@@ -74,6 +74,20 @@ if [ "${T1_KEEP_JAX_CACHE:-0}" != "1" ]; then
     rm -rf .jax_cache
 fi
 
+# Pre-flight: the graft-lint static scan (docs/ANALYSIS.md) — the
+# knob-bridge / recompile-hazard / host-sync / lock-discipline / names
+# contracts are source properties, so a violation fails fast here
+# instead of surfacing as a flaky runtime symptom mid-suite (or not at
+# all).  Pure stdlib-ast work, ~a second.  T1_SKIP_LINT=1 opts out
+# (e.g. when bisecting a runtime-only failure on a known-dirty tree).
+if [ "${T1_SKIP_LINT:-0}" != "1" ]; then
+    if ! env JAX_PLATFORMS=cpu python -m mpi_tensorflow_tpu.analysis; then
+        echo "[t1_guard] graft-lint found new violations (above) — fix" \
+             "or annotate them, or rerun with T1_SKIP_LINT=1"
+        exit 1
+    fi
+fi
+
 PYTEST_ARGS=("$@")
 if [ ${#PYTEST_ARGS[@]} -eq 0 ]; then
     if [ -n "${T1_FILES:-}" ]; then
