@@ -434,6 +434,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     kv_ab: bool = False,
                     prefix_cache: str | None = None,
                     prefix_tokens: int = 0,
+                    prefix_gen: str | None = None,
+                    prefix_route: str | None = None,
                     speculative: str | None = None,
                     draft_k: int | None = None,
                     spec_ab: bool = False,
@@ -526,6 +528,22 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     the pool-occupancy delta — plus a token-identity cross-check
     against the unshared arm.
 
+    Prefix sharing v2: ``prefix_gen`` (--serve-prefix-gen: off|on)
+    turns on generated-block caching + partial tail-block sharing and
+    adds a seeded MULTI-TURN arm — an untimed discovery pass learns
+    each request's answer, a follow-up turn replays every request as
+    prior prompt + answer + a pre-drawn unique suffix
+    (loadgen follow-up mode), and the two-turn trace runs through the
+    gen-on engine AND a gen-off control (cache still on); the
+    ``prefix_gen`` detail carries ``gen_inserted_blocks``, the
+    hit-rate / prefill-tokens-saved gains, and the token-identity
+    cross-check.  ``prefix_route`` (--serve-prefix-route: off|on) adds
+    a 2-replica ROUTING arm: the same trace (sessionless, so affinity
+    never preempts the hint) through a hint-on fleet and a
+    least-load-only control; the ``prefix_route`` detail carries
+    ``router_prefix_hits``, the aggregate hit-rate comparison, and
+    token identity vs both the control fleet and the single engine.
+
     Speculative decoding: ``speculative`` (--serve-speculative:
     off|ngram|draft-model; None = the run Config's default) drafts
     ``draft_k`` tokens per live sequence and verifies them in one
@@ -612,6 +630,16 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     model = gpt.CausalLm(bcfg)
     params = model.init(jax.random.key(0))
     max_len = max(len(p) + o for p, o in zip(prompts, outputs))
+    gen_mode = prefix_gen if prefix_gen is not None else cfg.serve_prefix_gen
+    if gen_mode == "on":
+        # the multi-turn gen arm's follow-up requests are prior prompt
+        # + answer (<= the output budget) + a short unique suffix, plus
+        # their own output budget — size the sequence cap for the
+        # longest possible turn-2 member up front (max_seq_len fixes
+        # the bucket ladder and max_blocks_per_seq at engine build)
+        max_len = max(max_len,
+                      max(len(p) + 2 * o for p, o in zip(prompts, outputs))
+                      + min(8, prompt_max))
     max_seq_len = pow2_ceil(max_len)
     bps = blocks_for(max_seq_len, block_size)
     if pool_blocks is None:
@@ -622,6 +650,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         cfg, num_blocks=pool_blocks, block_size=block_size,
         max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
         kv_dtype=kv_dtype, prefix_cache=prefix_cache,
+        prefix_gen=prefix_gen, prefix_route=prefix_route,
         speculative=speculative,
         draft_k=draft_k, draft_auto=draft_auto, tp=tp,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
@@ -706,6 +735,11 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                          "arm; combining it with --serve-kv-ab would "
                          "change two variables in one comparison — "
                          "pick one")
+    if serve.prefix_route == "on" and replicas > 1:
+        raise ValueError("--serve-prefix-route on adds its own "
+                         "2-replica hint-on-vs-off routing arm; "
+                         "combining it with --serve-replicas would run "
+                         "two fleets in one bench — pick one")
 
     def _roofline(resolved_kernel: str) -> dict:
         """Bytes-per-decode-token ESTIMATE for both lowerings, from the
@@ -778,6 +812,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "serve_kv_dtype": serve.kv_dtype,
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
+            "serve_prefix_gen": serve.prefix_gen,
+            "serve_prefix_route": serve.prefix_route,
             "serve_speculative": serve.speculative,
             "serve_draft_k": serve.draft_k,
             "serve_draft_auto": serve.draft_auto,
@@ -848,6 +884,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "prefix": res.get("prefix"),
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
+            "serve_prefix_gen": serve.prefix_gen,
+            "serve_prefix_route": serve.prefix_route,
             "speculation": res.get("speculation"),
             "serve_speculative": serve.speculative,
             "serve_draft_k": serve.draft_k,
@@ -1014,7 +1052,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         # trace's wall clock, which would skew deadline/shed outcomes
         # and the occupancy comparison against the warmed cache-on arm
         eng_off = PagedDecodeEngine(
-            model, params, dc.replace(serve, prefix_cache="off"))
+            model, params, dc.replace(serve, prefix_cache="off",
+                                      prefix_gen="off",
+                                      prefix_route="off"))
         eng_off.run(trace())
         eng_off.reset()
         off = eng_off.run(trace())
@@ -1031,6 +1071,109 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "peak_blocks_in_use": cb["peak_blocks_in_use"],
             "peak_blocks_in_use_off": off["peak_blocks_in_use"],
             "token_identical_vs_off": off["outputs"] == cb["outputs"],
+        }
+
+    gen_detail = None
+    if serve.prefix_gen == "on":
+        # the multi-turn generated-block arm: rebuild the trace spec
+        # with one seeded follow-up turn (the followup draws come LAST
+        # in the rng order, so turn 1 is byte-identical to the main
+        # trace), learn each request's answer in an untimed discovery
+        # pass, then replay the combined two-turn trace through the
+        # gen-on engine and a gen-off control (cache still on — the
+        # PR-13 baseline).  The win is the follow-up prompts' generated
+        # region mapping out of the trie instead of re-prefilling; the
+        # contract is token identity between the arms.
+        spec2 = dc.replace(trace_spec, followup_turns=1)
+        trace2_b = loadgen.build_trace(spec2)
+        engine.reset()
+        disc = engine.run(trace())        # discovery: learn the answers
+        t1_end = float(trace2_b.arrivals[-1])
+
+        def mt_trace():
+            return trace2_b.requests() + trace2_b.followup_requests(
+                1, trace2_b.requests(), disc["outputs"],
+                id_base=num_requests, arrival_base=t1_end)
+
+        engine.reset()
+        engine.run(mt_trace())            # warm the turn-2 buckets
+        w_g = engine.compile_counts()
+        engine.reset()
+        on_r = engine.run(mt_trace())
+        s_g = engine.compile_counts()
+        eng_goff = PagedDecodeEngine(
+            model, params, dc.replace(serve, prefix_gen="off",
+                                      prefix_route="off"))
+        eng_goff.run(mt_trace())
+        eng_goff.reset()
+        off_r = eng_goff.run(mt_trace())
+        gen_detail = {
+            "turns": 2,
+            "requests_per_turn": num_requests,
+            "prefix_on": on_r["prefix"],
+            "prefix_off": off_r["prefix"],
+            # THE gen-arm acceptance numbers: generated blocks actually
+            # entered the trie, and the follow-up turn's reuse beats the
+            # prompt-only (v1) baseline strictly
+            "gen_inserted_blocks":
+                on_r["prefix"]["gen_inserted_blocks"],
+            "partial_copy_tokens":
+                on_r["prefix"]["partial_copy_tokens"],
+            "hit_rate_gain": round(on_r["prefix"]["hit_rate"]
+                                   - off_r["prefix"]["hit_rate"], 4),
+            "prefill_tokens_saved_gain": (
+                on_r["prefix"]["prefill_tokens_saved"]
+                - off_r["prefix"]["prefill_tokens_saved"]),
+            "tokens_per_sec": {"gen_on": on_r["tokens_per_sec"],
+                               "gen_off": off_r["tokens_per_sec"]},
+            "token_identical_vs_off":
+                on_r["outputs"] == off_r["outputs"],
+            "ab_zero_recompile": (w_g == s_g
+                                  if all(v is not None for v in
+                                         {**w_g, **s_g}.values())
+                                  else None),
+        }
+
+    route_detail = None
+    if serve.prefix_route == "on":
+        # the prefix-aware routing arm: the SAME (sessionless) trace
+        # through a 2-replica fleet with the hint on, and through the
+        # same engines least-load-only — the only variable is the
+        # placement stage, so a higher aggregate hit rate is pure
+        # locality (requests sharing a leading block land on the
+        # replica that already cached it instead of splitting across
+        # both tries).  Token identity must hold against both the
+        # control fleet and the single timed engine.
+        from mpi_tensorflow_tpu.serving.router import ReplicaRouter
+
+        fleet_engines = [PagedDecodeEngine(model, params, serve)
+                         for _ in range(2)]
+        r_on = ReplicaRouter(fleet_engines, prefix_route=True)
+        r_on.run(trace())                 # warm each replica's buckets
+        r_on.reset()
+        ron = r_on.run(trace())
+        hits = ron["prefix"]["router_prefix_hits"]
+        r_off = ReplicaRouter(fleet_engines, prefix_route=False)
+        r_off.reset()                     # fresh tries; jit caches stay
+        roff = r_off.run(trace())
+        route_detail = {
+            "n": 2,
+            "router_prefix_hits": hits,
+            "prefix_on": ron["prefix"],
+            "prefix_off": roff["prefix"],
+            # aggregate full-block reuse with vs without the hint — THE
+            # routing acceptance number (the hint concentrates shared
+            # prefixes instead of duplicating them per replica)
+            "hit_rate": {"route_on": ron["prefix"]["hit_rate"],
+                         "route_off": roff["prefix"]["hit_rate"]},
+            "hit_rate_gain": round(ron["prefix"]["hit_rate"]
+                                   - roff["prefix"]["hit_rate"], 4),
+            "tokens_per_sec": {"route_on": ron["tokens_per_sec"],
+                               "route_off": roff["tokens_per_sec"]},
+            "token_identical_vs_off":
+                ron["outputs"] == roff["outputs"],
+            "token_identical_vs_single":
+                ron["outputs"] == cb["outputs"],
         }
 
     spec_detail = cb["speculation"]
@@ -1169,8 +1312,12 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "kv_quant": kv_detail,
         "serve_kv_dtype": serve.kv_dtype,
         "prefix": prefix_detail,
+        "prefix_gen": gen_detail,
+        "prefix_route": route_detail,
         "serve_prefix_cache": serve.prefix_cache,
         "serve_prefix_tokens": prefix_tokens,
+        "serve_prefix_gen": serve.prefix_gen,
+        "serve_prefix_route": serve.prefix_route,
         "speculation": spec_detail,
         "spec_ab": spec_ab_detail,
         "serve_speculative": serve.speculative,
@@ -1532,6 +1679,17 @@ def _stale_score(args, d: dict, item=None):
         if d.get("serve_prefix_cache", "off") != \
                 (getattr(args, "serve_prefix_cache", None)
                  or serve_defaults.serve_prefix_cache):
+            return None
+        # prefix v2 reshapes the arms (gen adds a multi-turn arm, route
+        # adds a 2-replica fleet) and the cache behavior itself (absent
+        # keys on old records read as the pre-v2 defaults: off, off)
+        if d.get("serve_prefix_gen", "off") != \
+                (getattr(args, "serve_prefix_gen", None)
+                 or serve_defaults.serve_prefix_gen):
+            return None
+        if d.get("serve_prefix_route", "off") != \
+                (getattr(args, "serve_prefix_route", None)
+                 or serve_defaults.serve_prefix_route):
             return None
         # speculative decoding changes the model family (rope workload)
         # AND the step structure — a record under a different drafter
@@ -1986,6 +2144,26 @@ def main(argv=None) -> int:
                          "prefix workload the prefix cache exists for "
                          "(0 = all-unique prompts, the historical "
                          "trace)")
+    ap.add_argument("--serve-prefix-gen", choices=["off", "on"],
+                    default=None,
+                    help="serving mode: prefix cache v2 — on caches a "
+                         "finished request's GENERATED blocks and "
+                         "shares partial tail blocks, and adds a "
+                         "seeded multi-turn arm (follow-up prompts "
+                         "embed the prior answer) with a gen-off "
+                         "control for the hit-rate gain and token "
+                         "identity; requires --serve-prefix-cache on "
+                         "(default: the run Config's serve_prefix_gen)")
+    ap.add_argument("--serve-prefix-route", choices=["off", "on"],
+                    default=None,
+                    help="serving mode: prefix-aware fleet routing — "
+                         "on adds a 2-replica arm placing requests by "
+                         "cached leading block (load-bounded hint) vs "
+                         "a least-load-only control, reporting router "
+                         "prefix hits, the aggregate hit-rate gain, "
+                         "and token identity; requires "
+                         "--serve-prefix-cache on (default: the run "
+                         "Config's serve_prefix_route)")
     ap.add_argument("--serve-speculative",
                     choices=["off", "ngram", "draft-model"], default=None,
                     help="serving mode: speculative decoding — draft k "
@@ -2136,6 +2314,24 @@ def main(argv=None) -> int:
         ap.error("--serve-prefix-cache on already adds its own cache-off "
                  "control arm; combine with --serve-kernel-ab one at a "
                  "time so each comparison has a single variable")
+    if (args.serve_prefix_gen is not None
+            or args.serve_prefix_route is not None) \
+            and args.mode != "serving":
+        ap.error("--serve-prefix-gen/--serve-prefix-route shape the "
+                 "serving arms; other modes would silently ignore them")
+    if args.serve_prefix_gen == "on" and args.serve_prefix_cache != "on":
+        ap.error("--serve-prefix-gen on extends the radix prefix cache; "
+                 "it needs --serve-prefix-cache on")
+    if args.serve_prefix_route == "on" \
+            and args.serve_prefix_cache != "on":
+        ap.error("--serve-prefix-route on routes by cached prefixes; it "
+                 "needs --serve-prefix-cache on")
+    if args.serve_prefix_route == "on" \
+            and (args.serve_replicas or 1) > 1:
+        ap.error("--serve-prefix-route on adds its own 2-replica "
+                 "hint-on-vs-off routing arm; combining it with "
+                 "--serve-replicas would run two fleets in one bench — "
+                 "pick one")
     if args.serve_draft_k is not None and args.serve_draft_k < 1:
         ap.error(f"--serve-draft-k must be >= 1, got "
                  f"{args.serve_draft_k}")
@@ -2300,6 +2496,8 @@ def main(argv=None) -> int:
                             kv_ab=args.serve_kv_ab,
                             prefix_cache=args.serve_prefix_cache,
                             prefix_tokens=args.serve_prefix_tokens,
+                            prefix_gen=args.serve_prefix_gen,
+                            prefix_route=args.serve_prefix_route,
                             speculative=args.serve_speculative,
                             draft_k=args.serve_draft_k,
                             spec_ab=args.serve_spec_ab,

@@ -166,6 +166,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "write on divergence, LRU trie eviction under "
                         "pool pressure; serving/prefix_cache); off "
                         "preserves the unshared behavior byte-for-byte")
+    p.add_argument("--serve-prefix-gen", choices=["off", "on"],
+                   default=d.serve_prefix_gen,
+                   help="serving: prefix cache v2 — on additionally "
+                        "caches a finished request's generated full "
+                        "blocks in the trie (multi-turn reuse) and "
+                        "shares partial tail blocks via a one-compile "
+                        "row-prefix copy; off keeps "
+                        "--serve-prefix-cache on behavior byte-for-"
+                        "byte; requires --serve-prefix-cache on")
+    p.add_argument("--serve-prefix-route", choices=["off", "on"],
+                   default=d.serve_prefix_route,
+                   help="serving: prefix-aware fleet routing — on "
+                        "biases sessionless placement toward the "
+                        "replica whose trie caches the prompt's "
+                        "leading full block (load-bounded; never "
+                        "overrides the health gate, never changes "
+                        "tokens; serving/router); requires "
+                        "--serve-prefix-cache on")
     p.add_argument("--serve-speculative",
                    choices=["off", "ngram", "draft-model"],
                    default=d.serve_speculative,
@@ -294,6 +312,8 @@ def config_from_args(args) -> Config:
         serve_kernel=args.serve_kernel,
         serve_kv_dtype=args.serve_kv_dtype,
         serve_prefix_cache=args.serve_prefix_cache,
+        serve_prefix_gen=args.serve_prefix_gen,
+        serve_prefix_route=args.serve_prefix_route,
         serve_speculative=args.serve_speculative,
         serve_draft_k=args.serve_draft_k,
         serve_draft_auto=args.serve_draft_auto,
@@ -367,6 +387,30 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"bad --serve-prefix-cache {config.serve_prefix_cache!r}: "
             f"must be off|on")
+    if config.serve_prefix_gen not in ("off", "on"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-prefix-gen {config.serve_prefix_gen!r}: "
+            f"must be off|on")
+    if config.serve_prefix_route not in ("off", "on"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-prefix-route {config.serve_prefix_route!r}: "
+            f"must be off|on")
+    if config.serve_prefix_gen == "on" \
+            and config.serve_prefix_cache == "off":
+        raise SystemExit(
+            "--serve-prefix-gen on extends the radix prefix cache; with "
+            "--serve-prefix-cache off it would be silently ignored — "
+            "turn the cache on or drop it")
+    if config.serve_prefix_route == "on" \
+            and config.serve_prefix_cache == "off":
+        raise SystemExit(
+            "--serve-prefix-route on routes by cached prefixes; with "
+            "--serve-prefix-cache off there is nothing to route by — "
+            "turn the cache on or drop it")
     if config.serve_kernel not in ("auto", "xla", "pallas"):
         # argparse choices guard the CLI path; this covers programmatic
         # Config construction routed through main

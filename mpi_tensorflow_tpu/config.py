@@ -127,6 +127,24 @@ class Config:
                                   # on-write, LRU trie eviction under
                                   # pool pressure); "off" preserves the
                                   # unshared behavior byte-for-byte
+    serve_prefix_gen: str = "off"  # prefix cache v2 extensions: "on"
+                                  # additionally caches a finished
+                                  # request's GENERATED full blocks in
+                                  # the trie (follow-up turns that embed
+                                  # the prior answer hit them) and
+                                  # shares partial tail blocks via a
+                                  # one-compile row-prefix copy; "off"
+                                  # keeps prefix_cache=on behavior
+                                  # byte-for-byte; requires
+                                  # serve_prefix_cache=on
+    serve_prefix_route: str = "off"  # prefix-aware fleet routing: "on"
+                                  # biases sessionless placement toward
+                                  # the replica whose trie caches the
+                                  # prompt's leading full block (load-
+                                  # bounded, never overrides the health
+                                  # gate, never changes tokens); "off"
+                                  # keeps affinity+least-load routing;
+                                  # requires serve_prefix_cache=on
     serve_speculative: str = "off"  # speculative decoding: "ngram"
                                   # (n-gram self-draft, zero extra
                                   # model), "draft-model" (tiny-model

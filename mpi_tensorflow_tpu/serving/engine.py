@@ -35,9 +35,14 @@ prompt through a radix trie of cached full blocks
 prefill computes only the unique suffix; the engine contributes the
 device half — a copy-on-write block copy before any dispatch would
 write into a shared block, and trie registration when a prompt finishes
-prefill.  Greedy outputs with the cache on are token-identical to
-cache-off for every request (the determinism contract the serving tests
-pin).
+prefill.  Prefix sharing v2 (``--serve-prefix-gen on``) extends the
+trie with a finishing request's generated blocks (multi-turn reuse)
+and serves mid-block misses through a pre-warmed one-compile partial
+tail-block copy (``_partial_fn``, the ``_cow_fn`` discipline), applied
+between admission and the first prefill chunk.  Greedy outputs with
+the cache on are token-identical to cache-off for every request (the
+determinism contract the serving tests pin), and v2-on is
+token-identical to v2-off.
 """
 
 from __future__ import annotations
@@ -75,6 +80,31 @@ class ServeConfig:
                                   # trie eviction under pressure);
                                   # "off" preserves byte-for-byte the
                                   # unshared behavior
+    prefix_gen: str = "off"       # prefix sharing v2 (--serve-prefix-
+                                  # gen): "on" additionally (a) inserts
+                                  # a finishing request's full blocks
+                                  # spanning prompt + generated output
+                                  # into the trie, so follow-up turns
+                                  # embedding the prior answer map them
+                                  # instead of re-prefilling, and (b)
+                                  # serves a mid-block miss's matched
+                                  # row prefix via the one-compile
+                                  # partial-copy dispatch.  Requires
+                                  # prefix_cache on; "off" keeps the
+                                  # trie prompt-blocks-only (v1),
+                                  # byte-for-byte
+    prefix_route: str = "off"     # prefix-aware fleet routing (--serve-
+                                  # prefix-route): "on" lets the
+                                  # replica router (serving/router)
+                                  # bias placement toward the replica
+                                  # whose trie already caches a
+                                  # request's leading full block, when
+                                  # load permits — never overriding
+                                  # health gating, never changing
+                                  # tokens.  Requires prefix_cache on;
+                                  # consumed by ReplicaRouter, carried
+                                  # here so the fleet's engines and the
+                                  # router agree through ONE config
     speculative: str = "off"      # speculative decoding (--serve-
                                   # speculative): "ngram" = n-gram
                                   # self-draft, "draft-model" = tiny-
@@ -158,6 +188,8 @@ class ServeConfig:
                     max_seq_len=config.serve_max_seq_len,
                     kernel=config.serve_kernel,
                     prefix_cache=config.serve_prefix_cache,
+                    prefix_gen=config.serve_prefix_gen,
+                    prefix_route=config.serve_prefix_route,
                     speculative=config.serve_speculative,
                     draft_k=config.serve_draft_k,
                     draft_auto=config.serve_draft_auto,
@@ -188,6 +220,24 @@ class ServeConfig:
             raise ValueError(
                 f"serve prefix cache must be off|on, "
                 f"got {self.prefix_cache!r}")
+        if self.prefix_gen not in ("off", "on"):
+            raise ValueError(
+                f"serve prefix_gen must be off|on, "
+                f"got {self.prefix_gen!r}")
+        if self.prefix_route not in ("off", "on"):
+            raise ValueError(
+                f"serve prefix_route must be off|on, "
+                f"got {self.prefix_route!r}")
+        if self.prefix_gen == "on" and self.prefix_cache == "off":
+            raise ValueError(
+                "serve prefix_gen extends the radix prefix cache; with "
+                "prefix_cache off it would be silently ignored — turn "
+                "the cache on or drop it")
+        if self.prefix_route == "on" and self.prefix_cache == "off":
+            raise ValueError(
+                "serve prefix_route biases placement toward cached "
+                "prefixes; with prefix_cache off there is no trie to "
+                "hint from — turn the cache on or drop it")
         if self.speculative not in ("off", "ngram", "draft-model"):
             raise ValueError(
                 f"serve speculative must be off|ngram|draft-model, "
@@ -307,6 +357,12 @@ class PagedDecodeEngine:
         self._cow_fn = jax.jit(
             self._cow_impl,
             donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
+        # partial tail-block copy (prefix v2): same discipline as
+        # _cow_fn — block ids AND the row count ride as traced scalars,
+        # so every (src, dst, n) reuses the one compiled program
+        self._partial_fn = jax.jit(
+            self._partial_impl,
+            donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
         # speculative decoding: the verify step runs pending + k draft
         # tokens through one forward (chunked-prefill math, decode-style
         # batching); the drafter is a host-side policy object built ONCE
@@ -333,6 +389,11 @@ class PagedDecodeEngine:
 
             z = jnp.asarray(0, jnp.int32)
             self.pools = self._cow_fn(self.pools, z, z)
+            if self.serve.prefix_gen == "on":
+                # same contract for the partial-copy dispatch: a zero-
+                # row null-block self-copy is a no-op write that pays
+                # its one compile before any timed window opens
+                self.pools = self._partial_fn(self.pools, z, z, z)
         if self.drafter is not None:
             # pre-warm the verify dispatch at EVERY (slot bucket, table
             # bucket) x width-(k+1) shape, plus the drafter's own chunk
@@ -378,6 +439,7 @@ class PagedDecodeEngine:
             queue_depth=self.serve.queue_depth,
             max_evictions=self.serve.max_evictions,
             prefix_cache=self.prefix_cache,
+            prefix_gen=self.serve.prefix_gen == "on",
             on_terminal=self._on_terminal)
         # pool-occupancy high-water marks: raw = every referenced block
         # (includes trie-retained blocks, which are reclaimable cache);
@@ -450,6 +512,13 @@ class PagedDecodeEngine:
         scalars, so every copy reuses the one compiled program."""
         return [{key: leaf.at[dst].set(leaf[src])
                  for key, leaf in p.items()} for p in pools]
+
+    def _partial_impl(self, pools, src, dst, n):
+        """Copy the first ``n`` token-slot rows of block ``src`` into
+        ``dst`` (serving/paged_cache.partial_copy_block): the device
+        half of partial tail-block sharing.  All three operands are
+        traced scalars — one compile, like ``_cow_impl``."""
+        return paged_cache.partial_copy_block(pools, src, dst, n)
 
     def _verify_impl(self, params, pools, tokens, lengths, n_valid,
                      tables):
@@ -542,6 +611,26 @@ class PagedDecodeEngine:
             self.sched.counters["prefix_cow_copies"] += 1
         return True
 
+    def _apply_partial_copies(self) -> None:
+        """Land every pending partial tail-block copy (prefix v2):
+        admission matched ``partial_rows`` leading tokens of a slot's
+        tail block against cached block ``partial_src`` and charged the
+        sequence as if they were prefilled — the rows must be on device
+        before the first prefill chunk reads past them.  Runs right
+        after admit() in step(), so eviction cannot intervene; a slot
+        whose sequence left anyway (pin already dropped by the
+        scheduler) is skipped."""
+        import jax.numpy as jnp
+
+        for seq in self.sched.slots:
+            if seq is None or seq.partial_src is None:
+                continue
+            self.pools = self._partial_fn(
+                self.pools, jnp.asarray(seq.partial_src, jnp.int32),
+                jnp.asarray(seq.partial_dst, jnp.int32),
+                jnp.asarray(seq.partial_rows, jnp.int32))
+            self.sched._release_partial(seq)
+
     def _track_occupancy(self) -> None:
         """Advance the pool-occupancy high-water marks (see reset)."""
         self.peak_blocks_in_use = max(self.peak_blocks_in_use,
@@ -626,6 +715,7 @@ class PagedDecodeEngine:
             self._progressed = True
         self._prefill_queue.extend(
             (slot, self.sched.slots[slot]) for slot in admitted)
+        self._apply_partial_copies()
         emitted = self._advance_prefill()
 
         if self.drafter is not None:
@@ -1011,6 +1101,7 @@ class PagedDecodeEngine:
         out = {"decode": size(self._decode_fn),
                "prefill": size(self._prefill_fn),
                "cow": size(self._cow_fn),
+               "partial": size(self._partial_fn),
                "verify": size(self._verify_fn)}
         if self.drafter is not None:
             # a drafter's own jitted dispatches are inside the steady-

@@ -23,6 +23,13 @@ callers drive an ``EngineLoop`` per engine:
 against a ``PreemptionGuard``: SIGTERM stops admission, sheds queued
 work, lets in-flight sequences finish inside ``drain_ms``, and cuts
 the rest as ``drained`` at the budget's hard edge.
+
+One-body-two-callers is also what keeps the prefix-cache token-identity
+contract (v1 AND the v2 generated-block/partial-copy extensions) a
+single proof: cache effects live entirely inside ``engine.step()`` /
+the scheduler's admission+terminal paths, so a trace replayed through
+``engine.run`` and through the fleet router crosses the SAME
+accounting here and emits the same tokens.
 """
 
 from __future__ import annotations

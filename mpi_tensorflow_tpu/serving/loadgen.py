@@ -137,6 +137,13 @@ class WorkloadSpec:
     tenants: Tuple[TenantClass, ...] = ()
     session_len: int = 1          # non-tenant workloads: mean multi-turn
                                   # session length (1 = no sessions)
+    followup_turns: int = 0       # seeded follow-up-turn mode (prefix
+                                  # v2 bench): each extra turn replays
+                                  # every request as prior prompt +
+                                  # ANSWER + a pre-drawn unique suffix
+                                  # (Trace.followup_requests); 0 draws
+                                  # nothing — the default trace stays
+                                  # byte-identical
 
     def __post_init__(self):
         if self.workload not in WORKLOADS:
@@ -187,6 +194,9 @@ class WorkloadSpec:
         if self.session_len < 1:
             raise ValueError(f"session_len must be >= 1, got "
                              f"{self.session_len}")
+        if self.followup_turns < 0:
+            raise ValueError(f"followup_turns must be >= 0, got "
+                             f"{self.followup_turns}")
 
 
 def default_tenants(spec: WorkloadSpec) -> Tuple[TenantClass, ...]:
@@ -219,6 +229,14 @@ class Trace:
     tenants: List[str]
     slos_ms: List[Optional[float]]
     sessions: List[Optional[str]]
+    # follow-up-turn mode (spec.followup_turns > 0): per-turn pre-drawn
+    # unique suffixes and arrival gaps — the seeded half of a follow-up
+    # prompt; the other half (the ANSWER) only exists after a run, so
+    # followup_requests() joins them post hoc
+    followup_suffixes: List[List[List[int]]] = \
+        dataclasses.field(default_factory=list)
+    followup_gaps: List[np.ndarray] = \
+        dataclasses.field(default_factory=list)
 
     def requests(self) -> List[Request]:
         return [
@@ -229,6 +247,38 @@ class Trace:
                               if self.slos_ms[i] is not None else None),
                     session=self.sessions[i])
             for i in range(len(self.prompts))]
+
+    def followup_requests(self, turn: int, prev_requests: List[Request],
+                          outputs: dict, *, id_base: int,
+                          arrival_base: float = 0.0) -> List[Request]:
+        """Materialize follow-up turn ``turn`` (1-based, up to
+        ``spec.followup_turns``): request ``i``'s new prompt is the
+        prior turn's FULL prompt + its generated answer (``outputs``
+        keyed by the prior request id — an engine/router run's
+        ``outputs`` dict) + this turn's pre-drawn unique suffix.  The
+        multi-turn regime generated-block caching exists for: everything
+        up to the suffix re-prefills on a v1 cache but maps straight out
+        of the trie under --serve-prefix-gen.  Ids start at ``id_base``
+        (distinct from every prior turn's); arrivals replay the turn's
+        seeded exponential gaps from ``arrival_base``."""
+        if not 1 <= turn <= len(self.followup_suffixes):
+            raise ValueError(
+                f"follow-up turn {turn} out of range: trace has "
+                f"{len(self.followup_suffixes)} "
+                f"(spec.followup_turns={self.spec.followup_turns})")
+        suffixes = self.followup_suffixes[turn - 1]
+        arr = arrival_base + np.cumsum(self.followup_gaps[turn - 1])
+        reqs = []
+        for i, prev in enumerate(prev_requests):
+            answer = list(outputs.get(prev.id, ()))
+            prompt = list(prev.prompt) + answer + suffixes[i]
+            a = float(arr[i])
+            reqs.append(Request(
+                id_base + i, prompt, self.outputs[i], a,
+                deadline=(a + self.slos_ms[i] / 1e3
+                          if self.slos_ms[i] is not None else None),
+                session=self.sessions[i]))
+        return reqs
 
 
 def _mmpp_arrivals(rng, n: int, spec: WorkloadSpec) -> np.ndarray:
@@ -380,9 +430,23 @@ def build_trace(spec: WorkloadSpec) -> Trace:
         sessions[i] = f"{key}:{sid}"
         state[key] = (sid, left - 1)
 
+    # follow-up-turn draws come LAST (0 turns draws nothing, so every
+    # pre-followup trace — including the pinned default — stays
+    # byte-identical): per turn, n short suffix lengths + tokens, then
+    # n exponential arrival gaps
+    followup_suffixes: List[List[List[int]]] = []
+    followup_gaps: List[np.ndarray] = []
+    for _ in range(spec.followup_turns):
+        lens = rng.integers(1, p_lo + 1, n)
+        followup_suffixes.append(
+            [list(map(int, rng.integers(0, spec.vocab_size, int(ln))))
+             for ln in lens])
+        followup_gaps.append(rng.exponential(1.0 / spec.rate_rps, n))
+
     return Trace(spec=spec, prompts=prompts, outputs=outputs,
                  arrivals=arrivals, tenants=tenant_names, slos_ms=slos,
-                 sessions=sessions)
+                 sessions=sessions, followup_suffixes=followup_suffixes,
+                 followup_gaps=followup_gaps)
 
 
 def per_request_rows(trace: Trace, result: dict) -> List[dict]:

@@ -119,6 +119,37 @@ def blocks_for(tokens: int, block_size: int) -> int:
     return -(-tokens // block_size)
 
 
+def partial_copy_block(pools: list, src, dst, n) -> list:
+    """Copy the first ``n`` token-slot rows of block ``src`` into block
+    ``dst`` across every pool leaf, leaving rows ``>= n`` of ``dst``
+    untouched — the device half of partial tail-block sharing
+    (prefix v2): the trie matched ``n`` leading tokens of a sequence's
+    tail block against a cached block, so those rows are copied out of
+    the cache instead of re-prefilled, and the unique suffix lands on
+    top.
+
+    ``src``/``dst``/``n`` are TRACED int32 scalars — the caller jits
+    this once (the ``_cow_fn`` discipline) and every (src, dst, n)
+    triple reuses that one executable; ``n == 0`` with ``src == dst``
+    is the no-op pre-warm dispatch.  The row mask broadcasts over the
+    4-d code leaves AND the 3-d int8 scale siblings (slot axis is axis
+    1 of ``leaf[src]`` either way), so quantized pools copy codes and
+    scales together.
+    """
+    import jax.numpy as jnp
+
+    out = []
+    for p in pools:
+        layer = {}
+        for key, leaf in p.items():
+            rows = jnp.arange(leaf.shape[2]) < n
+            mask = rows.reshape((-1,) + (1,) * (leaf.ndim - 3))
+            layer[key] = leaf.at[dst].set(
+                jnp.where(mask, leaf[src], leaf[dst]))
+        out.append(layer)
+    return out
+
+
 def init_pools(cfg, num_blocks: int, block_size: int,
                kv_dtype: str = "fp32") -> list:
     """Per-layer K/V block pools (zeros), mirroring the per-layer

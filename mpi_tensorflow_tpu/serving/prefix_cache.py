@@ -22,10 +22,20 @@ BLOCK granularity:
 - ``insert`` runs when a sequence finishes prefill: the trie adopts the
   sequence's full-prompt blocks it has not seen before (its own
   ``share`` ref per node), making them matchable by later requests.
-  Only full PROMPT blocks enter the trie — the partial tail block that
-  also receives generated tokens never does, so a cached block's
-  content is immutable by construction and writes into shared blocks
-  happen only on the engine's explicit copy-on-write path.
+  With generated-block caching on (--serve-prefix-gen, prefix v2) the
+  scheduler ALSO inserts a finished sequence's full blocks spanning
+  prompt + generated output, so a follow-up turn that embeds the prior
+  answer maps those blocks instead of re-prefilling them
+  (RadixAttention's generation-caching rule).  Either way only FULL,
+  fully-written blocks enter the trie — a partial tail block that may
+  still receive writes never does, so a cached block's content is
+  immutable by construction and writes into shared blocks happen only
+  on the engine's explicit copy-on-write path.
+- ``match_partial`` (prefix v2) extends a full-block match into the
+  tail: when the walk ends mid-block, the best-matching child's block
+  donates its matched row prefix via the engine's one-compile
+  partial-copy dispatch into the sequence's private tail block, so up
+  to ``block_size - 1`` tokens per miss stop being recomputed.
 - ``evict`` frees least-recently-used UNREFERENCED leaves (refcount 1:
   only the trie holds the block) under pool pressure, so sharing never
   starves admission.  Leaves only: an interior node's children encode
@@ -81,6 +91,12 @@ class PrefixCache:
         self.num_blocks = 0          # nodes == distinct pool blocks held
         self.inserted = 0            # nodes ever adopted
         self.evicted = 0             # nodes LRU-evicted
+        # Observer for ROOT-child membership (leading full-block keys):
+        # called as root_hook(key, True) when a first-block node is
+        # adopted and root_hook(key, False) when one is evicted.  The
+        # replica router's prefix-aware placement feeds its owner map
+        # from this digest; None (the default) costs nothing.
+        self.root_hook = None
 
     def _tick(self) -> int:
         self._clock += 1
@@ -118,6 +134,46 @@ class PrefixCache:
             cached = len(prompt) - 1
         return ids, cached
 
+    def match_partial(self, prompt: List[int],
+                      matched_blocks: int) -> Optional[Tuple[int, int]]:
+        """Best mid-block extension of a full-block match: re-walks the
+        trie to depth ``matched_blocks`` and, among that node's
+        children, finds the block whose token key shares the longest
+        ROW PREFIX with the prompt's tail.  Returns ``(block, rows)``
+        with one ``share`` reference taken on ``block`` — the PIN that
+        keeps trie eviction from freeing (and the allocator from
+        recycling) the source before the engine's partial-copy dispatch
+        reads it; the caller releases it after the copy.  None when no
+        child shares at least one usable row.
+
+        ``rows`` is capped at ``len(tail) - 1`` so the final prompt
+        position always recomputes (the ``match_and_share`` rule: its
+        argmax IS the first output token).  When the tail spans a full
+        block a whole-key match is impossible here — the main walk
+        would have taken it — so ``rows < block_size`` always holds and
+        the copy never substitutes for a full-block share."""
+        node, bs = self._root, self.block_size
+        for j in range(matched_blocks):
+            node = node.children.get(tuple(prompt[j * bs:(j + 1) * bs]))
+            if node is None:          # concurrent eviction below a match
+                return None
+        tail = prompt[matched_blocks * bs:]
+        limit = min(len(tail) - 1, bs)
+        if limit <= 0:
+            return None
+        best, best_rows = None, 0
+        for key, child in node.children.items():
+            r = 0
+            while r < limit and r < len(key) and key[r] == tail[r]:
+                r += 1
+            if r > best_rows:
+                best, best_rows = child, r
+        if best is None:
+            return None
+        best.last_used = self._tick()
+        self.allocator.share([best.block])
+        return best.block, best_rows
+
     # ---------------- registration ----------------
 
     def insert(self, prompt: List[int], block_ids: List[int]) -> int:
@@ -138,6 +194,8 @@ class PrefixCache:
                 self.num_blocks += 1
                 self.inserted += 1
                 added += 1
+                if node is self._root and self.root_hook is not None:
+                    self.root_hook(key, True)
             child.last_used = self._tick()
             node = child
         return added
@@ -175,6 +233,8 @@ class PrefixCache:
             self.num_blocks -= 1
             self.evicted += 1
             freed += 1
+            if victim.parent is self._root and self.root_hook is not None:
+                self.root_hook(victim.key, False)
         return freed
 
     # ---------------- invariants / stats ----------------

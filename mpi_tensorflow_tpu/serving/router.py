@@ -17,7 +17,14 @@ Placement policy, in order:
    ejected re-homes on its next request.
 2. **Health gate** — only replicas the circuit breaker calls routable
    (``healthy`` or ``probing``) take work.
-3. **Least load** — scored from the schedulers' OWN signals: waiting-
+3. **Prefix hint** (``--serve-prefix-route on``, prefix v2) — a
+   router-level map from leading full-block token keys to the replica
+   whose trie cached them (fed by each trie's root-child digest via
+   ``PrefixCache.root_hook``); a sessionless request whose first block
+   is cached somewhere is biased toward that replica WHEN LOAD PERMITS
+   (within one waiting request of the least-loaded score).  Placement
+   only: it never overrides the health gate and never changes tokens.
+4. **Least load** — scored from the schedulers' OWN signals: waiting-
    queue depth (dominant), live-slot fraction, pool occupancy, shed
    rate.
 
@@ -168,10 +175,12 @@ class ReplicaRouter:
     #: every access to these attrs must sit inside `with self._lock`
     #: (the PR 7 sticky-map race class — see docs/ANALYSIS.md)
     _GUARDED_BY = {"_lock": ("_sticky", "_session_live", "_outstanding",
-                             "fleet_counters", "_drain_counts")}
+                             "fleet_counters", "_drain_counts",
+                             "_prefix_owner")}
 
     def __init__(self, engines: List, *, make_engine=None,
-                 probe_ticks: int = 4, max_sticky: int = 1024):
+                 probe_ticks: int = 4, max_sticky: int = 1024,
+                 prefix_route: Optional[bool] = None):
         if not engines:
             raise ValueError("ReplicaRouter needs >= 1 engine replica")
         if probe_ticks < 1 or max_sticky < 1:
@@ -182,6 +191,12 @@ class ReplicaRouter:
         self.make_engine = make_engine
         self.probe_ticks = probe_ticks
         self.max_sticky = max_sticky
+        # prefix-aware placement (prefix v2): None resolves through the
+        # fleet's ServeConfig (--serve-prefix-route) — the explicit
+        # boolean exists for bench's hint-on-vs-off A/B over one fleet
+        self._prefix_route = (engines[0].serve.prefix_route == "on"
+                              if prefix_route is None
+                              else bool(prefix_route))
         base = engines[0].serve.failover_backoff_ms / 1e3
         self.backoff_base_s = base
         self.backoff_cap_s = base * 64
@@ -201,6 +216,8 @@ class ReplicaRouter:
         self.health = [ReplicaHealth() for _ in range(n)]
         # graft-lint: lock-ok(cold init: no worker threads exist yet)
         self.fleet_counters: Counter = Counter()
+        # graft-lint: lock-ok(cold init: no worker threads exist yet)
+        self._prefix_owner: Dict = {}   # leading block key -> replica
         self._last_error: Optional[BaseException] = None
 
     def reset(self) -> None:
@@ -260,6 +277,34 @@ class ReplicaRouter:
                     i = None
                 elif i is not None:
                     self._sticky.move_to_end(key)   # LRU touch
+        if i is None and self._prefix_route:
+            # prefix-aware hint (prefix v2): if some replica's trie
+            # caches this prompt's LEADING full block, send the request
+            # there — its expected cached prefix (and everything the
+            # radix walk finds below that block) beats a cold replica's
+            # full prefill.  Load-bounded: the owner must score within
+            # ONE waiting request of the least-loaded routable replica,
+            # so the hint can shape placement but never pile work onto
+            # a saturated replica; and it is health-gated by the same
+            # ``ok`` set as every other placement.  Tokens never change
+            # — a mis-hint only costs a cache miss.
+            bs = self.engines[0].serve.block_size
+            if len(req.prompt) >= bs:
+                with self._lock:
+                    owner = self._prefix_owner.get(tuple(req.prompt[:bs]))
+                if owner is not None and owner in ok:
+                    depths = inbox_depths or [0] * len(self.engines)
+                    best = min(self.load_score(j, depths[j]) for j in ok)
+                    if self.load_score(owner, depths[owner]) <= best + 1.0:
+                        i = owner
+                        with self._lock:
+                            self.fleet_counters["router_prefix_hits"] += 1
+                            if key is not None:
+                                # hint placements seed affinity too:
+                                # the session's later turns should find
+                                # the prefix where this one put it
+                                self._sticky[key] = i
+                                self._sticky.move_to_end(key)
         if i is None:
             depths = inbox_depths or [0] * len(self.engines)
             i = min(ok, key=lambda j: (self.load_score(j, depths[j]), j))
@@ -278,6 +323,22 @@ class ReplicaRouter:
         return i
 
     # ---------------- terminal / sticky bookkeeping ----------------
+
+    def _note_prefix(self, i: int, key, present: bool) -> None:
+        """Per-replica trie digest sink (``PrefixCache.root_hook``): a
+        leading full-block token key entered (``present``) or left
+        replica ``i``'s trie.  Last inserter wins on collision — a key
+        cached on two replicas routes to the most recent one, which is
+        also the most recently used (warmest) copy.  Runs on the
+        replica's own worker thread, hence the lock."""
+        with self._lock:
+            if present:
+                self._prefix_owner[key] = i
+            elif self._prefix_owner.get(key) == i:
+                # only the recorded owner's eviction clears the entry:
+                # another replica's eviction must not erase a mapping
+                # that still names a live copy elsewhere
+                del self._prefix_owner[key]
 
     def _notify_terminal(self, i: int, req, status: str) -> None:
         """Chained behind each adopted engine's own terminal hook: one
@@ -311,10 +372,29 @@ class ReplicaRouter:
 
     def stats(self) -> dict:
         """Router health/affinity accounting (the fleet_faults block
-        plus the sticky-map hygiene counters)."""
+        plus the sticky-map hygiene counters), plus a per-replica prefix
+        trie snapshot — the fleet-level view of where cached prefixes
+        live and how hard each trie is working."""
         from mpi_tensorflow_tpu.utils.metrics_writer import \
             fleet_faults_block
 
+        # trie/scheduler reads are worker-owned state: best-effort
+        # snapshots (int reads are atomic under the GIL; same contract
+        # as _observe_fleet), taken OUTSIDE the router lock
+        tries = []
+        for i, eng in enumerate(self.engines):
+            pc = eng.prefix_cache
+            row = {"replica": i, "enabled": pc is not None}
+            if pc is not None:
+                row.update(pc.stats())       # blocks/inserted/evicted
+                row["hit_tokens"] = int(
+                    eng.sched.counters.get("prefix_hit_tokens", 0))
+                row["gen_inserted_blocks"] = int(
+                    eng.sched.counters.get("prefix_gen_inserted_blocks",
+                                           0))
+                row["occupancy"] = round(
+                    pc.num_blocks / max(1, eng.serve.num_blocks - 1), 4)
+            tries.append(row)
         # one lock hold for the whole snapshot: stats() is callable
         # mid-run, and an unlocked read races the workers' updates
         with self._lock:
@@ -326,6 +406,11 @@ class ReplicaRouter:
                     int(self.fleet_counters["sticky_rehomed"]),
                 "sticky_evicted":
                     int(self.fleet_counters["sticky_evicted"]),
+                "prefix_route": self._prefix_route,
+                "prefix_owner_keys": len(self._prefix_owner),
+                "router_prefix_hits":
+                    int(self.fleet_counters["router_prefix_hits"]),
+                "replica_tries": tries,
                 "health": [dataclasses.asdict(h) for h in self.health],
                 "fleet_faults": fleet_faults_block(self.fleet_counters),
             }
@@ -344,6 +429,14 @@ class ReplicaRouter:
             self._notify_terminal(_i, req, status)
 
         engine.sched.on_terminal = hook
+        if self._prefix_route and engine.prefix_cache is not None:
+            # feed the router's owner map from this replica's trie
+            # digest; installed here (not __init__) because reset() and
+            # probe rebuilds create FRESH PrefixCache objects, and every
+            # incarnation reaches traffic through _bind
+            engine.prefix_cache.root_hook = (
+                lambda key, present, _i=i:
+                self._note_prefix(_i, key, present))
         self._loops[i] = EngineLoop(engine, self._journals[i])
 
     def _failover(self, i: int, exc: BaseException, now: float) -> None:
@@ -386,6 +479,12 @@ class ReplicaRouter:
             for k in stale:
                 del self._sticky[k]
             self.fleet_counters["sticky_rehomed"] += len(stale)
+            # prefix hints to the dead incarnation are stale too: its
+            # pools are gone, so routing toward it buys nothing (the
+            # hint path also health-gates, but the map should not pin
+            # memory for a replica that may never return)
+            for k in [k for k, v in self._prefix_owner.items() if v == i]:
+                del self._prefix_owner[k]
         if transient:
             h.faults += 1
             h.backoff_s = min(self.backoff_cap_s,
@@ -766,7 +865,7 @@ class ReplicaRouter:
 
     def _aggregate(self, parallel: bool, elapsed: float) -> dict:
         from mpi_tensorflow_tpu.utils.metrics_writer import (
-            faults_block, fleet_faults_block)
+            faults_block, fleet_faults_block, prefix_block)
 
         totals: Counter = Counter()
         per_replica = []
@@ -833,6 +932,17 @@ class ReplicaRouter:
             drain_counts = Counter(self._drain_counts)
             sticky_n = len(self._sticky)
         drain = self._drain.result_counts(drain_counts)
+        # fleet prefix view: scheduler counters summed over replicas
+        # plus the router's own hint-hit count — the aggregate the
+        # prefix-route A/B compares (per-replica detail is in stats())
+        fleet_prefix = prefix_block(
+            totals,
+            enabled=any(e.prefix_cache is not None for e in self.engines),
+            trie_blocks=sum(e.prefix_cache.num_blocks
+                            for e in self.engines
+                            if e.prefix_cache is not None),
+            router_prefix_hits=int(
+                fleet_counters["router_prefix_hits"]))
         return {
             "parallel": parallel,
             "outputs": outputs,
@@ -843,6 +953,7 @@ class ReplicaRouter:
             "health": [h.state for h in self.health],
             "replicas": per_replica,
             "num_replicas": len(self.engines),
+            "prefix": fleet_prefix,
             "sticky_sessions": sticky_n,
             "placements": dict(self.placements),
             "tokens": total,

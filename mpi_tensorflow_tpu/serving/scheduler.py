@@ -101,6 +101,16 @@ class Sequence:
     generated: List[int] = dataclasses.field(default_factory=list)
     done: bool = False
     prefix_cached: int = 0        # prompt tokens served by cache hits
+    # Partial tail-block sharing (prefix v2): admission matched
+    # ``partial_rows`` leading tokens of this sequence's tail block
+    # against cached block ``partial_src`` (pinned: one share ref held
+    # until the engine's partial-copy dispatch lands or the sequence
+    # leaves the slot) to be copied into its private ``partial_dst``.
+    # ``prefilled`` already counts those rows — the engine MUST apply
+    # the copy before the first prefill chunk touches the slot.
+    partial_src: Optional[int] = None
+    partial_dst: int = 0
+    partial_rows: int = 0
 
     @property
     def length(self) -> int:
@@ -152,6 +162,15 @@ class Scheduler:
                           blocks are LRU-evicted BEFORE any live
                           sequence is preempted.  None = sharing off —
                           byte-for-byte today's behavior.
+    - ``prefix_gen``      prefix sharing v2 (--serve-prefix-gen): a
+                          finishing sequence inserts its full blocks
+                          spanning prompt + generated output into the
+                          trie (before its own release, so the blocks
+                          survive by the trie's share ref), and
+                          admission extends a mid-block miss with a
+                          partial tail-block copy.  Off = the trie
+                          holds full PROMPT blocks only, byte-for-byte
+                          the v1 behavior.
     """
 
     def __init__(self, allocator: BlockAllocator, max_slots: int,
@@ -161,7 +180,8 @@ class Scheduler:
                  starvation_steps: Optional[int] = 64,
                  on_terminal: Optional[Callable[[Request, str],
                                                 None]] = None,
-                 prefix_cache: Optional[PrefixCache] = None):
+                 prefix_cache: Optional[PrefixCache] = None,
+                 prefix_gen: bool = False):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.allocator = allocator
@@ -173,6 +193,7 @@ class Scheduler:
         self.starvation_steps = starvation_steps
         self.on_terminal = on_terminal
         self.prefix_cache = prefix_cache
+        self.prefix_gen = prefix_gen
         self.waiting: deque = deque()
         self.slots: List[Optional[Sequence]] = [None] * max_slots
         self.finished: List[Sequence] = []
@@ -333,9 +354,29 @@ class Scheduler:
             self.counters["prefix_prompt_tokens"] += len(req.prompt)
             self.counters["prefix_hit_tokens"] += cached_tokens
             self.counters["prefix_shared_blocks"] += len(cached_ids)
-        self.slots[slot] = Sequence(
-            req, cached_ids + self.allocator.alloc(need),
-            prefilled=cached_tokens, prefix_cached=cached_tokens)
+        partial = None
+        if (self.prefix_gen and self.prefix_cache is not None
+                and cached_tokens == len(cached_ids) * self.block_size):
+            # the full-block walk ended on a real miss (an uncapped
+            # match — a capped one means the whole prompt is cached and
+            # the tail recompute is the match_and_share rule, not a
+            # miss): try to serve the tail block's leading rows from
+            # the best-matching cached sibling.  ``need >= 1`` is
+            # guaranteed here (the uncached suffix is non-empty), so
+            # the first fresh block below IS the copy destination.
+            partial = self.prefix_cache.match_partial(
+                req.prompt, len(cached_ids))
+        blocks = cached_ids + self.allocator.alloc(need)
+        seq = Sequence(req, blocks, prefilled=cached_tokens,
+                       prefix_cached=cached_tokens)
+        if partial is not None:
+            src, rows = partial
+            seq.partial_src = src
+            seq.partial_dst = blocks[len(cached_ids)]
+            seq.partial_rows = rows
+            seq.prefilled = seq.prefix_cached = cached_tokens + rows
+            self.counters["prefix_partial_copy_tokens"] += rows
+        self.slots[slot] = seq
 
     def _admit_hit_aware(self, slot: int) -> bool:
         """The block-starved bypass: admit the closest queued request
@@ -372,6 +413,16 @@ class Scheduler:
         return False
 
     # ---------------- per-step bookkeeping ----------------
+
+    def _release_partial(self, seq: Sequence) -> None:
+        """Drop the partial-copy source pin (if any) — called by the
+        engine once its copy dispatch lands, and by every path that
+        removes the sequence from its slot first (eviction, failure,
+        finish) so the pin can never outlive the sequence."""
+        if seq.partial_src is not None:
+            self.allocator.release([seq.partial_src])
+            seq.partial_src = None
+            seq.partial_rows = 0
 
     def live_slots(self) -> List[int]:
         return [i for i, s in enumerate(self.slots)
@@ -479,6 +530,7 @@ class Scheduler:
         _, victim = max(candidates)
         seq = self.slots[victim]
         self.allocator.release(seq.block_ids)
+        self._release_partial(seq)
         self.slots[victim] = None
         self.evictions += 1
         self.counters["evictions"] += 1
@@ -505,6 +557,20 @@ class Scheduler:
         if (len(seq.generated) >= seq.request.max_new_tokens
                 or (eos_id is not None and token == eos_id)):
             seq.done = True
+            self._release_partial(seq)
+            if self.prefix_gen and self.prefix_cache is not None:
+                # generated-block insertion (prefix v2): adopt the full
+                # blocks spanning prompt + generated BEFORE this
+                # sequence's release below, so they survive by the
+                # trie's own share refs (check_quiescent's
+                # trie-only-refs rule).  Only the ``length - 1`` cache
+                # entries actually WRITTEN are insertable — the final
+                # token is pending, and under speculation positions
+                # past it hold rejected phantom writes.
+                stream = list(seq.request.prompt) + seq.generated
+                added = self.prefix_cache.insert(
+                    stream[:seq.length - 1], seq.block_ids)
+                self.counters["prefix_gen_inserted_blocks"] += added
             self.allocator.release(seq.block_ids)
             seq.block_ids = []
             self.finished.append(seq)
@@ -540,6 +606,7 @@ class Scheduler:
         recycle the slot — the other in-flight streams keep serving."""
         seq = self.slots[slot]
         self.allocator.release(seq.block_ids)
+        self._release_partial(seq)
         seq.block_ids = []
         self.slots[slot] = None
         self._terminal(seq.request, status)

@@ -120,14 +120,22 @@ def fleet_faults_block(counters) -> dict:
     return {k: int(counters.get(k, 0)) for k in FLEET_FAULT_KEYS}
 
 
-def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0) -> dict:
+def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0,
+                 router_prefix_hits: int = 0) -> dict:
     """Normalize scheduler/supervisor counters into the canonical
     serving ``prefix`` (radix prefix cache) accounting block — one
     constructor shared by engine results, the recovery supervisor's
-    cross-attempt merge, and bench JSON, so the key set and the
-    hit-rate rounding can never drift between them."""
+    cross-attempt merge, router aggregation, and bench JSON, so the key
+    set and the hit-rate rounding can never drift between them.
+
+    ``hit_rate`` counts FULL-BLOCK sharing only; partial tail-block
+    rows ride separately as ``partial_copy_tokens``, and
+    ``prefill_tokens_saved`` is the prefix-v2 headline — every prompt
+    position served out of cache (full blocks + partial rows) instead
+    of recomputed."""
     hit = int(counters.get("prefix_hit_tokens", 0))
     total = int(counters.get("prefix_prompt_tokens", 0))
+    partial = int(counters.get("prefix_partial_copy_tokens", 0))
     return {
         "enabled": bool(enabled),
         "hit_tokens": hit,
@@ -141,6 +149,16 @@ def prefix_block(counters, *, enabled: bool, trie_blocks: int = 0) -> dict:
         # cached prefix made them fit (the scheduler's hit-aware
         # admission policy); 0 when the pool never came under pressure
         "hit_admissions": int(counters.get("prefix_hit_admissions", 0)),
+        # prefix v2 (--serve-prefix-gen): trie nodes adopted from
+        # GENERATED output at request completion, and tail rows served
+        # through the partial-copy dispatch instead of re-prefill
+        "gen_inserted_blocks":
+            int(counters.get("prefix_gen_inserted_blocks", 0)),
+        "partial_copy_tokens": partial,
+        "prefill_tokens_saved": hit + partial,
+        # prefix v2 (--serve-prefix-route): fleet placements the
+        # router's prefix hint decided (always 0 for a single engine)
+        "router_prefix_hits": int(router_prefix_hits),
     }
 
 

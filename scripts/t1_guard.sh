@@ -57,6 +57,13 @@
 #                                   # and the prefix/eviction/rollback/
 #                                   # replay composition pins
 #                                   # (test_kv_quant)
+#        T1_FILES="tests/test_prefix_v2.py tests/test_serving.py" \
+#            scripts/t1_guard.sh    # prefix sharing v2 smoke: gen-block
+#                                   # insertion + partial tail copy +
+#                                   # router hint (token identity, the
+#                                   # refcount property test, knob
+#                                   # coupling) next to the v1 cache,
+#                                   # scheduler, and engine pins
 
 set -u
 cd "$(dirname "$0")/.."

@@ -167,6 +167,93 @@ def test_knob_bridge_dead_field():
                for f in found)
 
 
+def _prefix_v2_tree(*, route_wired=True, gen_validated=True):
+    """The prefix-v2 knob pair (--serve-prefix-gen/-route) as a
+    minimal bridge fixture: two choices-validated string knobs with
+    cli.main coupling guards, breakable one layer at a time."""
+    route_wire = ("serve_prefix_route=args.serve_prefix_route,"
+                  if route_wired else "")
+    gen_post = ('if self.prefix_gen not in ("off", "on"):\n'
+                '                        raise ValueError("bad")'
+                if gen_validated else "pass")
+    return {
+        "pkg/cli.py": _src(f"""
+            import argparse
+            from pkg.config import Config
+
+            def build_parser():
+                p = argparse.ArgumentParser()
+                p.add_argument("--serve-prefix-gen",
+                               choices=["off", "on"], default="off")
+                p.add_argument("--serve-prefix-route",
+                               choices=["off", "on"], default="off")
+                return p
+
+            def config_from_args(args):
+                return Config(
+                    serve_prefix_gen=args.serve_prefix_gen,
+                    {route_wire})
+
+            def main(argv=None):
+                args = build_parser().parse_args(argv)
+                config = config_from_args(args)
+                if config.serve_prefix_gen not in ("off", "on"):
+                    raise SystemExit("bad gen")
+                if config.serve_prefix_route not in ("off", "on"):
+                    raise SystemExit("bad route")
+                return config
+            """),
+        "pkg/config.py": _src("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class Config:
+                serve_prefix_gen: str = "off"
+                serve_prefix_route: str = "off"
+            """),
+        "pkg/serve.py": _src(f"""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                prefix_gen: str = "off"
+                prefix_route: str = "off"
+
+                def __post_init__(self):
+                    {gen_post}
+                    if self.prefix_route not in ("off", "on"):
+                        raise ValueError("bad")
+
+                @classmethod
+                def from_config(cls, cfg):
+                    return cls(prefix_gen=cfg.serve_prefix_gen,
+                               prefix_route=cfg.serve_prefix_route)
+
+            def use(serve):
+                return (serve.prefix_gen, serve.prefix_route)
+            """),
+    }
+
+
+def test_prefix_v2_knob_pair_green():
+    tree = _prefix_v2_tree()
+    assert knob_bridge._find_cli(core.parse_sources(tree)) is not None
+    assert knob_bridge.run(tree) == []
+
+
+def test_prefix_v2_route_not_wired_red():
+    found = knob_bridge.run(_prefix_v2_tree(route_wired=False))
+    assert any(f.pass_id == "KNOB-FLAG"
+               and "serve-prefix-route" in f.message for f in found)
+
+
+def test_prefix_v2_gen_post_init_missing_red():
+    found = knob_bridge.run(_prefix_v2_tree(gen_validated=False))
+    assert any(f.pass_id == "KNOB-GUARD"
+               and "__post_init__ never validates" in f.message
+               and "prefix_gen" in f.message for f in found)
+
+
 # ---------------------------------------------------------------------
 # recompile-hazard (jit_stability)
 # ---------------------------------------------------------------------

@@ -322,3 +322,66 @@ class TestScaleAdvisor:
                           "decisions", "policy"}
         assert r["ticks"] == 1 and r["decisions"] == []
         assert r["policy"]["high_load"] == 4.0
+
+
+@pytest.mark.quick
+class TestFollowupTurns:
+    def test_zero_turns_default_stays_pinned(self):
+        """followup draws come LAST in build_trace, so enabling them
+        must not perturb turn 1 — and the default (0 turns) trace
+        remains byte-identical to the legacy pin."""
+        base = loadgen.build_trace(loadgen.WorkloadSpec())
+        multi = loadgen.build_trace(
+            loadgen.WorkloadSpec(followup_turns=2))
+        assert multi.prompts == base.prompts
+        assert multi.outputs == base.outputs
+        assert np.array_equal(multi.arrivals, base.arrivals)
+        assert base.followup_suffixes == [] and base.followup_gaps == []
+        assert len(multi.followup_suffixes) == 2
+        assert len(multi.followup_gaps) == 2
+
+    def test_followup_prompt_composition_and_seeding(self):
+        spec = loadgen.WorkloadSpec(num_requests=4, followup_turns=1,
+                                    slo_ms=250.0)
+        t = loadgen.build_trace(spec)
+        prev = t.requests()
+        outputs = {r.id: [900 + r.id, 901 + r.id] for r in prev}
+        f = t.followup_requests(1, prev, outputs, id_base=100,
+                                arrival_base=7.0)
+        assert [r.id for r in f] == [100, 101, 102, 103]
+        for i, (p, r) in enumerate(zip(prev, f)):
+            assert r.prompt[:len(p.prompt)] == list(p.prompt)
+            ans = r.prompt[len(p.prompt):len(p.prompt) + 2]
+            assert ans == outputs[p.id]
+            suffix = r.prompt[len(p.prompt) + 2:]
+            assert suffix == t.followup_suffixes[0][i]
+            assert len(suffix) >= 1
+            assert r.max_new_tokens == t.outputs[i]
+            assert r.arrival >= 7.0
+            assert r.deadline == pytest.approx(r.arrival + 0.25)
+        # (spec, seed) reproducibility covers the follow-up draws too
+        t2 = loadgen.build_trace(spec)
+        f2 = t2.followup_requests(1, prev, outputs, id_base=100,
+                                  arrival_base=7.0)
+        assert [r.prompt for r in f2] == [r.prompt for r in f]
+        assert [r.arrival for r in f2] == [r.arrival for r in f]
+
+    def test_missing_output_falls_back_to_prompt_only(self):
+        spec = loadgen.WorkloadSpec(num_requests=2, followup_turns=1)
+        t = loadgen.build_trace(spec)
+        prev = t.requests()
+        f = t.followup_requests(1, prev, {}, id_base=10)
+        for p, r in zip(prev, f):
+            assert r.prompt[:len(p.prompt)] == list(p.prompt)
+
+    def test_out_of_range_turn_rejected(self):
+        t = loadgen.build_trace(
+            loadgen.WorkloadSpec(num_requests=2, followup_turns=1))
+        with pytest.raises(ValueError, match="out of range"):
+            t.followup_requests(2, t.requests(), {}, id_base=10)
+        with pytest.raises(ValueError, match="out of range"):
+            t.followup_requests(0, t.requests(), {}, id_base=10)
+
+    def test_negative_turns_rejected(self):
+        with pytest.raises(ValueError, match="followup_turns"):
+            loadgen.WorkloadSpec(followup_turns=-1)

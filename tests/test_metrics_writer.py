@@ -184,3 +184,27 @@ class TestMetricsWriter:
         losses = [r["value"] for r in recs if r["tag"] == "train/loss"]
         assert all(v == v for v in losses) and losses   # finite stream
         assert res.num_steps > 0
+
+
+@pytest.mark.quick
+class TestPrefixBlockV2:
+    def test_prefix_block_v2_keys_and_saved_tokens(self):
+        """prefill_tokens_saved = full-block hit tokens + partial-copy
+        rows; hit_rate stays FULL-BLOCK-only (the v1 pin), and the v2
+        counters normalize to plain ints with zero-safe defaults."""
+        from collections import Counter
+
+        block = metrics_writer.prefix_block(
+            Counter(prefix_hit_tokens=40, prefix_prompt_tokens=100,
+                    prefix_partial_copy_tokens=6,
+                    prefix_gen_inserted_blocks=3),
+            enabled=True, trie_blocks=9, router_prefix_hits=2)
+        assert block["hit_rate"] == 0.4          # partial rows excluded
+        assert block["gen_inserted_blocks"] == 3
+        assert block["partial_copy_tokens"] == 6
+        assert block["prefill_tokens_saved"] == 46
+        assert block["router_prefix_hits"] == 2
+        empty = metrics_writer.prefix_block(Counter(), enabled=False)
+        assert empty["prefill_tokens_saved"] == 0
+        assert empty["gen_inserted_blocks"] == 0
+        assert empty["router_prefix_hits"] == 0

@@ -1044,7 +1044,9 @@ class TestPrefixCacheEngine:
         assert res["prefix"] == {
             "enabled": False, "hit_tokens": 0, "prompt_tokens": 0,
             "hit_rate": 0.0, "shared_blocks": 0, "cow_copies": 0,
-            "trie_evictions": 0, "trie_blocks": 0, "hit_admissions": 0}
+            "trie_evictions": 0, "trie_blocks": 0, "hit_admissions": 0,
+            "gen_inserted_blocks": 0, "partial_copy_tokens": 0,
+            "prefill_tokens_saved": 0, "router_prefix_hits": 0}
         assert res["outputs"][0] == res["outputs"][1] \
             == _generate_ref(model, params, p, 3)
         assert engine.allocator.num_used == 0
