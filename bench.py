@@ -440,6 +440,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     draft_k: int | None = None,
                     spec_ab: bool = False,
                     draft_auto: str | None = None,
+                    mixed: str | None = None,
+                    prefill_budget: int | None = None,
+                    mixed_ab: bool = False,
                     tp: int | None = None,
                     replicas: int | None = None,
                     fault_replica: int | None = None,
@@ -559,6 +562,22 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     draft-window auto-tuning (--serve-draft-auto; the ``speculation``
     block reports the resulting ``effective_k``).
 
+    Mixed batching: ``mixed`` (--serve-mixed-batch: off|on; None = the
+    run Config's default) fuses budget-capped prefill chunks
+    (``prefill_budget`` tokens per step, --serve-prefill-budget) into
+    the decode dispatch so mid-prefill requests stop stalling decode
+    steps — greedy outputs are token-identical to off by construction.
+    ``mixed_ab`` additionally TIMES a mixed-off control arm (own
+    warmup, own zero-recompile probe) and emits the ``mixed_ab``
+    block: per-arm ``dispatches_per_token`` (THE CPU-visible win — the
+    fused path must run strictly fewer forwards per emitted token),
+    per-arm ``ttft_p99_ms`` from the goodput TTFT stamps (mixed must
+    not regress it), ``token_identical_vs_off``, and the off arm's
+    zero-recompile probe.  Mutually exclusive with every other A/B or
+    control-arm mode (one comparison, one variable); speculative
+    decoding is excluded at the ServeConfig layer already (both
+    replace the decode dispatch).
+
     Distributed serving: ``tp`` shards the timed engine tensor-parallel
     over the first ``tp`` visible devices (serving/tp — the dispatch
     discipline, zero-recompile probes, and every control arm work
@@ -652,7 +671,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         kv_dtype=kv_dtype, prefix_cache=prefix_cache,
         prefix_gen=prefix_gen, prefix_route=prefix_route,
         speculative=speculative,
-        draft_k=draft_k, draft_auto=draft_auto, tp=tp,
+        draft_k=draft_k, draft_auto=draft_auto,
+        mixed_batch=mixed, prefill_budget=prefill_budget, tp=tp,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
         max_evictions=max_evictions, drain_ms=drain_ms)
     # resolve the unset knob through cfg like every other serve knob,
@@ -740,6 +760,29 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                          "2-replica hint-on-vs-off routing arm; "
                          "combining it with --serve-replicas would run "
                          "two fleets in one bench — pick one")
+    if mixed_ab and serve.mixed_batch == "off":
+        raise ValueError("--serve-mixed-ab compares mixed batching "
+                         "against its off arm; turn the fused path on "
+                         "with --serve-mixed-batch on")
+    if mixed_ab and journal is not None:
+        raise ValueError("--serve-mixed-ab is a measurement (two timed "
+                         "arms); the journaled serve mode is not — pick "
+                         "one")
+    if mixed_ab and (kernel_ab or spec_ab or kv_ab):
+        raise ValueError("--serve-mixed-ab, --serve-kernel-ab, "
+                         "--serve-spec-ab and --serve-kv-ab each replay "
+                         "the trace through their own control arm; one "
+                         "comparison, one variable — pick one")
+    if mixed_ab and replicas > 1:
+        raise ValueError("--serve-replicas adds its own comparison arm "
+                         "(aggregate vs single engine); combining it "
+                         "with --serve-mixed-ab would change two "
+                         "variables in one comparison — pick one")
+    if mixed_ab and serve.prefix_cache == "on":
+        raise ValueError("--serve-prefix-cache on adds its own "
+                         "cache-off control arm; combining it with "
+                         "--serve-mixed-ab would change two variables "
+                         "in one comparison — pick one")
 
     def _roofline(resolved_kernel: str) -> dict:
         """Bytes-per-decode-token ESTIMATE for both lowerings, from the
@@ -1211,6 +1254,66 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                            {**w_off, **s_off}.values()) else None),
             }
 
+    mixed_ab_detail = None
+    if mixed_ab:
+        # the mixed-off control arm: SAME trace through the byte-for-
+        # byte two-dispatch loop (one prefill forward + one decode
+        # forward per step), own untimed warmup, own zero-recompile
+        # probe — exactly the kernel/spec A/B discipline.  The headline
+        # is NOT wall clock (on CPU both arms are host-bound): it is
+        # dispatches-per-emitted-token, the hardware-independent count
+        # of model forwards the fused path saved, plus the TTFT
+        # percentiles the stall-free packing exists to improve.
+        eng_off = PagedDecodeEngine(
+            model, params, dc.replace(serve, mixed_batch="off"))
+        # the two-dispatch loop's decode buckets track LIVE occupancy,
+        # which tracks wall-clock arrival timing — on a bursty trace
+        # the timed replay reaches (batch, table-width) pairs the
+        # (compile-stalled, hence slower) warmup replay never did, and
+        # one recompile stall then cascades into queueing that skews
+        # TTFT and the dispatch counts this comparison exists for.
+        # Sweep the full decode bucket grid up front — the off-arm
+        # analogue of the fused path's build-time pre-warm (which is
+        # immune by construction) — then replay for the prefill shapes.
+        eng_off.prewarm_decode()
+        eng_off.run(trace())
+        w_m = eng_off.compile_counts()
+        eng_off.reset()
+        off = eng_off.run(trace())
+        s_m = eng_off.compile_counts()
+        gp_on = metrics_writer.goodput_block(
+            loadgen.per_request_rows(trace_b, cb),
+            elapsed_s=cb["elapsed_s"])
+        gp_off = metrics_writer.goodput_block(
+            loadgen.per_request_rows(trace_b, off),
+            elapsed_s=off["elapsed_s"])
+        mixed_ab_detail = {
+            "prefill_budget": serve.prefill_budget,
+            "tokens_per_sec": {"mixed": cb["tokens_per_sec"],
+                               "off": off["tokens_per_sec"]},
+            # THE win metric: model forwards per emitted token — mixed
+            # must be STRICTLY lower (it folds the prefill forwards the
+            # off arm pays separately into the decode dispatch)
+            "dispatches_per_token": {
+                "mixed": cb["dispatches_per_token"],
+                "off": off["dispatches_per_token"]},
+            "dispatch_reduction": (
+                round(1.0 - cb["dispatches_per_token"]
+                      / off["dispatches_per_token"], 4)
+                if off["dispatches_per_token"] > 0 else None),
+            # stall-free packing must not trade first-token latency
+            # away: p99 TTFT no worse than the off arm's
+            "ttft_p50_ms": {"mixed": gp_on["ttft_p50_ms"],
+                            "off": gp_off["ttft_p50_ms"]},
+            "ttft_p99_ms": {"mixed": gp_on["ttft_p99_ms"],
+                            "off": gp_off["ttft_p99_ms"]},
+            "token_identical_vs_off": off["outputs"] == cb["outputs"],
+            "ab_zero_recompile": (w_m == s_m
+                                  if all(v is not None for v in
+                                         {**w_m, **s_m}.values())
+                                  else None),
+        }
+
     replicas_detail = None
     if replicas > 1:
         # the data-parallel scale-out arm: the SAME trace through N
@@ -1323,6 +1426,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "serve_speculative": serve.speculative,
         "serve_draft_k": serve.draft_k,
         "serve_draft_auto": serve.draft_auto,
+        "mixed_ab": mixed_ab_detail,
+        "serve_mixed_batch": serve.mixed_batch,
+        "serve_prefill_budget": serve.prefill_budget,
         "serve_tp": serve.tp,
         "serve_replicas": replicas,
         "serve_workload": workload,
@@ -1335,6 +1441,10 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "serving_tokens_per_sec": cb["tokens_per_sec"],
         "p50_token_latency_ms": cb["p50_token_latency_ms"],
         "p99_token_latency_ms": cb["p99_token_latency_ms"],
+        # model forwards the timed arm ran and its per-emitted-token
+        # rate — the dispatch-economy number mixed batching improves
+        "forward_dispatches": cb["forward_dispatches"],
+        "dispatches_per_token": cb["dispatches_per_token"],
         "static_batch_tokens_per_sec": static_tps,
         "speedup_vs_static": (cb["tokens_per_sec"] / static_tps
                               if static_tps > 0 else None),
@@ -1710,6 +1820,21 @@ def _stale_score(args, d: dict, item=None):
                 (getattr(args, "serve_draft_auto", None)
                  or serve_defaults.serve_draft_auto):
             return None      # the tuned window changes the step structure
+        # mixed batching replaces the step structure (one fused forward
+        # vs the two-dispatch loop) and the budget shapes how much
+        # prefill rides each step; a mixed A/B request is two live arms
+        # by definition (absent keys on old records read as the
+        # pre-mixed defaults: off, no A/B)
+        if getattr(args, "serve_mixed_ab", False) or d.get("mixed_ab"):
+            return None
+        want_mixed = (getattr(args, "serve_mixed_batch", None)
+                      or serve_defaults.serve_mixed_batch)
+        if d.get("serve_mixed_batch", "off") != want_mixed:
+            return None
+        if want_mixed != "off" and d.get("serve_prefill_budget") != \
+                (getattr(args, "serve_prefill_budget", None)
+                 or serve_defaults.serve_prefill_budget):
+            return None
         # distributed serving shapes the timed arm (tp shards it) and
         # the comparison set (replicas adds a routed arm) — a record
         # under a different layout is a different number (absent keys
@@ -1898,6 +2023,14 @@ def _report(args, d: dict, stale: bool = False) -> int:
         if sab is not None:
             # THE wall-clock line the spec A/B flag exists for
             out["spec_speedup"] = sab.get("spec_speedup_vs_off")
+        mab = d.get("mixed_ab")
+        if mab is not None:
+            # THE numbers the mixed A/B flag exists for: the fraction
+            # of model forwards the fused path saved per emitted token,
+            # and the p99 first-token latency of both arms
+            out["mixed_dispatch_reduction"] = mab.get(
+                "dispatch_reduction")
+            out["mixed_ttft_p99_ms"] = mab.get("ttft_p99_ms")
         reps = d.get("replicas")
         if reps is not None:
             # THE scale-out line the replica flag exists for: the routed
@@ -1911,6 +2044,12 @@ def _report(args, d: dict, stale: bool = False) -> int:
             out["goodput_tokens_per_sec"] = gp.get(
                 "goodput_tokens_per_sec")
             out["slo_attainment"] = gp.get("slo_attainment")
+        if gp:
+            # first-token latency rides the goodput block whether or
+            # not an SLO was set — queueing + prefill delay is the
+            # half of serving latency tokens/sec cannot see
+            out["ttft_p50_ms"] = gp.get("ttft_p50_ms")
+            out["ttft_p99_ms"] = gp.get("ttft_p99_ms")
         _print_json(out)
         return 0
     if args.mode == "decode":
@@ -2223,6 +2362,32 @@ def main(argv=None) -> int:
                          "recompile probe) and emit the spec_speedup "
                          "line — mirrors --serve-kernel-ab and is "
                          "mutually exclusive with it")
+    ap.add_argument("--serve-mixed-batch", choices=["off", "on"],
+                    default=None,
+                    help="serving mode: stall-free mixed batching — on "
+                         "fuses budget-capped prefill chunks from "
+                         "multiple mid-prefill requests into the decode "
+                         "dispatch (ONE forward per step instead of a "
+                         "prefill forward plus a decode forward), "
+                         "token-identical to off by construction; "
+                         "mutually exclusive with --serve-speculative "
+                         "(both replace the decode dispatch) (default: "
+                         "the run Config's serve_mixed_batch)")
+    ap.add_argument("--serve-prefill-budget", type=int, default=None,
+                    help="serving mode: max prefill tokens fused into "
+                         "one mixed step — bounds each decode token's "
+                         "latency cost; consumed only with "
+                         "--serve-mixed-batch on (default: the run "
+                         "Config's serve_prefill_budget)")
+    ap.add_argument("--serve-mixed-ab", action="store_true",
+                    help="serving mode: TIME a mixed-off control arm "
+                         "too (own warmup, own zero-recompile probe) "
+                         "and emit the mixed_ab block — per-arm "
+                         "dispatches-per-emitted-token (the fused path "
+                         "must be strictly lower), per-arm TTFT "
+                         "percentiles, and token identity; mirrors "
+                         "--serve-kernel-ab and is mutually exclusive "
+                         "with every other A/B or control-arm mode")
     ap.add_argument("--serve-tiny", action="store_true",
                     help="serving mode: BERT_TINY model geometry — the "
                          "smoke/fault-injection configuration, not a "
@@ -2420,6 +2585,44 @@ def main(argv=None) -> int:
         ap.error("--serve-speculative already adds its own off control "
                  "arm; combine with --serve-kernel-ab one at a time so "
                  "each comparison has a single variable")
+    if (args.serve_mixed_batch is not None
+            or args.serve_prefill_budget is not None
+            or args.serve_mixed_ab) and args.mode != "serving":
+        ap.error("--serve-mixed-batch/--serve-prefill-budget/"
+                 "--serve-mixed-ab shape the serving step structure; "
+                 "other modes would silently ignore them")
+    if args.serve_prefill_budget is not None \
+            and args.serve_prefill_budget < 1:
+        ap.error(f"--serve-prefill-budget must be >= 1, got "
+                 f"{args.serve_prefill_budget}")
+    if args.serve_mixed_batch == "on" \
+            and args.serve_speculative not in (None, "off"):
+        ap.error("--serve-mixed-batch on and --serve-speculative each "
+                 "replace the decode dispatch with their own fused "
+                 "forward; they do not compose — pick one")
+    if args.serve_mixed_ab and args.serve_mixed_batch in (None, "off"):
+        ap.error("--serve-mixed-ab compares mixed batching against its "
+                 "off arm; turn the fused path on with "
+                 "--serve-mixed-batch on")
+    if args.serve_mixed_ab and (args.serve_kernel_ab or args.serve_spec_ab
+                                or args.serve_kv_ab):
+        ap.error("--serve-mixed-ab, --serve-kernel-ab, --serve-spec-ab "
+                 "and --serve-kv-ab each replay the trace through their "
+                 "own control arm; one comparison, one variable — pick "
+                 "one")
+    if args.serve_mixed_ab and args.serve_journal:
+        ap.error("--serve-mixed-ab is a measurement (two timed arms); "
+                 "the journaled serve mode is not — pick one")
+    if args.serve_mixed_ab and (args.serve_replicas or 1) > 1:
+        ap.error("--serve-replicas adds its own routed arm (aggregate "
+                 "vs single engine); combining it with --serve-mixed-ab "
+                 "would change two variables in one comparison — pick "
+                 "one")
+    if args.serve_mixed_ab and args.serve_prefix_cache == "on":
+        ap.error("--serve-prefix-cache on already adds its own "
+                 "cache-off control arm; combine with --serve-mixed-ab "
+                 "one at a time so each comparison has a single "
+                 "variable")
     if args.prng != "threefry" and args.mode != "train":
         ap.error("--prng shapes the training dropout stream; decode/"
                  "allreduce modes have no dropout and would silently "
@@ -2502,6 +2705,9 @@ def main(argv=None) -> int:
                             draft_k=args.serve_draft_k,
                             spec_ab=args.serve_spec_ab,
                             draft_auto=args.serve_draft_auto,
+                            mixed=args.serve_mixed_batch,
+                            prefill_budget=args.serve_prefill_budget,
+                            mixed_ab=args.serve_mixed_ab,
                             tp=args.serve_tp,
                             replicas=args.serve_replicas,
                             fault_replica=args.serve_fault_replica,

@@ -208,6 +208,21 @@ def build_parser() -> argparse.ArgumentParser:
                         "width never changes, so the zero-recompile "
                         "contract is untouched); needs a drafter "
                         "(--serve-speculative ngram|draft-model)")
+    p.add_argument("--serve-mixed-batch", choices=["off", "on"],
+                   default=d.serve_mixed_batch,
+                   help="serving: stall-free mixed batching — on fuses "
+                        "budget-capped prefill chunks from multiple "
+                        "mid-prefill sequences into the decode dispatch "
+                        "so every step is ONE forward (chunked-prefill "
+                        "math, token-identical to off by construction); "
+                        "off preserves the two-dispatch prefill-then-"
+                        "decode loop byte-for-byte")
+    p.add_argument("--serve-prefill-budget", type=int,
+                   default=d.serve_prefill_budget,
+                   help="serving: mixed-batching budget — max prefill "
+                        "tokens fused into one step across all "
+                        "mid-prefill sequences (>= 1; consumed only "
+                        "with --serve-mixed-batch on)")
     p.add_argument("--serve-tp", type=int, default=d.serve_tp,
                    help="serving: tensor-parallel shards for the decode "
                         "engine — >1 partitions the paged pool's head "
@@ -317,6 +332,8 @@ def config_from_args(args) -> Config:
         serve_speculative=args.serve_speculative,
         serve_draft_k=args.serve_draft_k,
         serve_draft_auto=args.serve_draft_auto,
+        serve_mixed_batch=args.serve_mixed_batch,
+        serve_prefill_budget=args.serve_prefill_budget,
         serve_tp=args.serve_tp,
         serve_replicas=args.serve_replicas,
         serve_deadline_ms=args.serve_deadline_ms,
@@ -433,6 +450,22 @@ def main(argv=None) -> int:
             "--serve-draft-auto on tunes the speculative draft window; "
             "with --serve-speculative off it would be silently ignored "
             "— pick a drafter or drop it")
+    if config.serve_mixed_batch not in ("off", "on"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-mixed-batch {config.serve_mixed_batch!r}: "
+            f"must be off|on")
+    if config.serve_prefill_budget < 1:
+        raise SystemExit(
+            f"bad --serve-prefill-budget {config.serve_prefill_budget}: "
+            f"the per-step fused prefill token budget must be >= 1")
+    if config.serve_mixed_batch == "on" \
+            and config.serve_speculative != "off":
+        raise SystemExit(
+            "--serve-mixed-batch on and --serve-speculative each replace "
+            "the decode dispatch with their own fused forward; they do "
+            "not compose — pick one")
     if config.serve_tp < 1 or config.serve_replicas < 1:
         # range guards only: head/mlp divisibility and the device-count
         # bound need the model geometry and an initialized backend, so

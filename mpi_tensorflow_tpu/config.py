@@ -165,6 +165,23 @@ class Config:
                                   # dispatch width never changes, so
                                   # no recompiles); "off" drafts the
                                   # configured k every step
+    serve_mixed_batch: str = "off"  # stall-free mixed batching: "on"
+                                  # fuses budget-capped prefill chunks
+                                  # from MULTIPLE mid-prefill sequences
+                                  # into the decode dispatch, so every
+                                  # step is ONE forward (chunked-prefill
+                                  # math; decode is the chunk=1 case)
+                                  # — lower dispatches per emitted
+                                  # token and lower TTFT under bursty
+                                  # admission; "off" preserves the
+                                  # two-dispatch prefill-then-decode
+                                  # loop byte-for-byte
+    serve_prefill_budget: int = 64  # mixed batching: max prefill
+                                  # tokens fused into one step across
+                                  # all mid-prefill sequences; bounds
+                                  # the decode-latency tax a step can
+                                  # pay for prompt ingestion (consumed
+                                  # only with serve_mixed_batch=on)
     serve_tp: int = 1             # tensor-parallel shards for the
                                   # decode engine: >1 partitions the
                                   # paged pool's head axis, the QKV/O

@@ -190,6 +190,15 @@ def paged_attention(q, ck, cv, q_positions, dt):
     The math IS models/gpt.forward_with_cache's attention
     (``masked_softmax_attention``): the greedy token-parity test pins
     this path to the contiguous one bit-for-bit on CPU.
+
+    MIXED-ROW CONTRACT: visibility is evaluated PER ROW against that
+    row's own ``q_positions`` — nothing couples rows, so one batch may
+    freely mix phases (decode rows querying a single position beside
+    prefill rows querying a chunk at their own offsets, the
+    --serve-mixed-batch fused dispatch).  Each row attends to exactly
+    the prefix its positions admit, identical to what a single-phase
+    dispatch would give it; tests/test_mixed_batch.py pins the fused
+    and unfused paths token-identical in fp32 and int8.
     """
     L = ck.shape[2]
     col = jnp.arange(L)
@@ -219,6 +228,18 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
                  neither.  Dequantization happens INSIDE the consume
                  path — in-register in the kernel, elementwise on the
                  gathered view here — so no fp pool ever materializes.
+
+    MIXED-ROW CONTRACT: ``lengths`` is per-row and the causal mask is
+    built per row from it (``pos = lengths[:, None] + arange(S)``), so
+    rows of ONE dispatch may sit at different phases — a decode row
+    (one real lane) beside prefill rows carrying chunks at their own
+    offsets, as the --serve-mixed-batch fused step packs them.  Rows
+    with fewer than S real lanes are the CALLER'S job to mask: slack
+    lanes must be marked invalid upstream so write_kv lands them in
+    the null block, and their attention output is garbage to be
+    discarded on host.  Both lowerings honor this identically (the
+    Pallas path masks by the same per-row positions), pinned in fp32
+    and int8 by tests/test_mixed_batch.py.
     """
     if (k_scale is None) != (v_scale is None):
         raise ValueError("int8 pools need both k_scale and v_scale")

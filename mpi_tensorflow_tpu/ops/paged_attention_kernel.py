@@ -232,6 +232,16 @@ def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
     softmax evaluation of ops/paged_attention.paged_attention over the
     gathered view — token-parity on the greedy decode path is pinned by
     tests/test_paged_kernel.py.
+
+    MIXED-ROW CONTRACT (lockstep with ops/paged_attention.attend): the
+    grid is (batch row, kv block) and every visibility test uses that
+    row's own ``lengths[b]``, so one dispatch may mix decode rows
+    (one real lane) with prefill rows carrying chunks at different
+    offsets — the --serve-mixed-batch fused step.  Slack lanes past a
+    row's real count are the caller's to mask upstream (their K/V
+    scatters to the null block); their output lanes are discarded on
+    host.  tests/test_mixed_batch.py pins kernel-vs-XLA agreement on
+    mixed batches in fp32 and int8.
     """
     if (k_scale is None) != (v_scale is None):
         raise ValueError("int8 pools need both k_scale and v_scale")

@@ -78,21 +78,29 @@ class ScaleAdvisor:
         self._cool = 0
 
     def load(self, *, queue_depth: float, occupancy: float,
-             shed_rate: float = 0.0, live_fraction: float = 0.0) -> float:
+             shed_rate: float = 0.0, live_fraction: float = 0.0,
+             prefill_backlog: float = 0.0) -> float:
         """Instantaneous per-replica load score (the router's
-        ``load_score`` weights), divided by the advised replica count."""
+        ``load_score`` weights), divided by the advised replica count.
+        ``prefill_backlog`` is admitted-but-unprefilled prompt work in
+        prefill-chunk units (engine.load_signals) — head-of-line
+        pressure the queue depth misses: a burst of long prompts fills
+        slots with sequences that emit nothing for many steps while
+        the waiting queue looks empty."""
         raw = (queue_depth + 0.5 * live_fraction + 0.3 * occupancy
-               + 0.2 * shed_rate)
+               + 0.2 * shed_rate + 0.2 * prefill_backlog)
         return raw / max(1, self.replicas)
 
     def observe(self, now_s: float, *, queue_depth: float,
                 occupancy: float, shed_rate: float = 0.0,
-                live_fraction: float = 0.0) -> Optional[dict]:
+                live_fraction: float = 0.0,
+                prefill_backlog: float = 0.0) -> Optional[dict]:
         """One tick: fold the signals into the load score, advance the
         hysteresis counters, and return the decision dict if one fired
         this tick (None otherwise — the common case)."""
         load = self.load(queue_depth=queue_depth, occupancy=occupancy,
-                         shed_rate=shed_rate, live_fraction=live_fraction)
+                         shed_rate=shed_rate, live_fraction=live_fraction,
+                         prefill_backlog=prefill_backlog)
         self.ticks += 1
         self.peak_load = max(self.peak_load, load)
         if self._cool > 0:

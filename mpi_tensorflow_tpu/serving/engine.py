@@ -13,7 +13,11 @@ bucketed shapes —
 - decode:  (slot bucket, table-width bucket), both powers of two, so at
   most ``(log2 max_slots + 1) * (log2 max_blocks_per_seq + 1)`` shapes;
 - prefill: (1, chunk bucket) with the full table width, at most
-  ``log2 prefill_chunk + 1`` shapes
+  ``log2 prefill_chunk + 1`` shapes;
+- mixed (``--serve-mixed-batch on``): (slot bucket, chunk bucket,
+  table-width bucket) for the ONE fused prefill+decode forward per
+  step — every triple pre-warmed at build, like speculative verify,
+  because which buckets a mixed step hits depends on arrival timing
 
 — so steady-state serving performs ZERO recompiles after bucket warmup
 (pinned by tests/test_serving.py via the jit cache-size probe).  The
@@ -127,6 +131,29 @@ class ServeConfig:
                                   # so the zero-recompile contract is
                                   # untouched.  "off" drafts the full
                                   # configured k every step
+    mixed_batch: str = "off"      # stall-free mixed batching (--serve-
+                                  # mixed-batch): "on" fuses budget-
+                                  # capped prefill chunks from MULTIPLE
+                                  # mid-prefill sequences into the
+                                  # decode dispatch, so every step is
+                                  # ONE forward — the chunked-prefill
+                                  # math already masks per-row lengths,
+                                  # and decode is its chunk=1
+                                  # degenerate case, so greedy outputs
+                                  # are token-identical to "off" by
+                                  # construction; "off" preserves the
+                                  # two-dispatch prefill-then-decode
+                                  # loop byte-for-byte.  Replaces the
+                                  # decode dispatch like speculative
+                                  # verify does, so the two do not
+                                  # compose
+    prefill_budget: int = 64      # mixed batching (--serve-prefill-
+                                  # budget): max prefill tokens fused
+                                  # into one step across all mid-
+                                  # prefill sequences — bounds the
+                                  # decode-latency tax a step pays for
+                                  # prompt ingestion (consumed only
+                                  # with mixed_batch on)
     kv_dtype: str = "fp32"        # pool storage format (--serve-kv-
                                   # dtype): "fp32" keeps blocks in the
                                   # model compute dtype — byte-for-byte
@@ -193,6 +220,8 @@ class ServeConfig:
                     speculative=config.serve_speculative,
                     draft_k=config.serve_draft_k,
                     draft_auto=config.serve_draft_auto,
+                    mixed_batch=config.serve_mixed_batch,
+                    prefill_budget=config.serve_prefill_budget,
                     kv_dtype=config.serve_kv_dtype,
                     tp=config.serve_tp,
                     deadline_ms=config.serve_deadline_ms,
@@ -253,6 +282,19 @@ class ServeConfig:
                 "serve draft_auto tunes the speculative draft window; "
                 "with speculative off it would be silently ignored — "
                 "pick a drafter or drop it")
+        if self.mixed_batch not in ("off", "on"):
+            raise ValueError(
+                f"serve mixed_batch must be off|on, "
+                f"got {self.mixed_batch!r}")
+        if self.prefill_budget < 1:
+            raise ValueError(
+                f"serve prefill_budget must be >= 1, "
+                f"got {self.prefill_budget}")
+        if self.mixed_batch == "on" and self.speculative != "off":
+            raise ValueError(
+                "serve mixed_batch and speculative each replace the "
+                "decode dispatch with their own fused forward; they do "
+                "not compose — pick one")
         if self.kv_dtype not in ("fp32", "int8"):
             raise ValueError(
                 f"serve kv dtype must be fp32|int8, got {self.kv_dtype!r}")
@@ -368,6 +410,10 @@ class PagedDecodeEngine:
         # batching); the drafter is a host-side policy object built ONCE
         # so its jit cache (draft-model mode) survives reset()
         self._verify_fn = jax.jit(self._verify_impl, donate_argnums=donate)
+        # mixed batching: ONE fused prefill+decode forward per step
+        # (--serve-mixed-batch on); shares the verify dispatch's
+        # masking math — decode rows are the chunk=1 degenerate case
+        self._mixed_fn = jax.jit(self._mixed_impl, donate_argnums=donate)
         self.drafter = spec_lib.make_drafter(
             serve.speculative, serve, model,
             draft_model=draft_model, draft_params=draft_params)
@@ -405,6 +451,14 @@ class PagedDecodeEngine:
             self._prewarm_verify()
             if hasattr(self.drafter, "warmup"):
                 self.drafter.warmup()
+        if serve.mixed_batch == "on":
+            # same contract for the fused mixed dispatch: which (slot,
+            # chunk, table) buckets a step hits depends on ARRIVAL
+            # TIMING — how many sequences are mid-prefill at once and
+            # how they split the budget — which a warmup trace replay
+            # cannot be trusted to reproduce.  Pay every bucket triple
+            # at build, before any timed window opens.
+            self._prewarm_mixed()
 
     def reset(self) -> None:
         """Fresh pools/scheduler; jit caches (and their warmed bucket
@@ -462,6 +516,11 @@ class PagedDecodeEngine:
         # while queued — a stale entry must not prefill the NEW occupant
         self._prefill_queue: List[tuple] = []
         self.dispatch_shapes: set = set()
+        # model-forward dispatches this run (prefill + decode + verify
+        # + mixed; CoW/partial copies excluded — they move cache rows,
+        # not tokens): dispatches-per-emitted-token is THE CPU-visible
+        # win metric of mixed batching (bench --serve-mixed-ab)
+        self.forward_dispatches = 0
 
     def _on_terminal(self, req, status: str) -> None:
         """THE per-request exit hook (installed on every scheduler this
@@ -543,13 +602,110 @@ class PagedDecodeEngine:
             params, tokens, pools, tables, lengths, valid)
         return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
 
+    def _mixed_impl(self, params, pools, tokens, lengths, n_valid,
+                    tables):
+        """The fused mixed prefill+decode dispatch (--serve-mixed-batch
+        on): row ``b`` feeds ``n_valid[b]`` real lanes at positions
+        ``lengths[b] + lane`` through ONE forward.  A decode row is the
+        chunk=1 degenerate case (its pending token at position
+        length-1); a prefill row is a budget-capped chunk of its prompt
+        at its prefilled offset.  The chunked-prefill math already
+        masks per-row lengths (ops/paged_attention.attend), so the
+        fused batch is EXACT — each row sees precisely the context the
+        unfused dispatch would give it, and greedy outputs are
+        token-identical to mixed-off by construction.  Returns the
+        greedy argmax at EVERY lane ``(B, S)``; the host consumes lane
+        ``n_valid[b] - 1`` for decode rows and prompt-completing
+        prefill rows only.  Padding lanes (row slack or bucket slack)
+        scatter into the null block and their argmax is discarded on
+        host."""
+        import jax.numpy as jnp
+
+        from mpi_tensorflow_tpu.ops.paged_attention import NULL_BLOCK
+
+        S = tokens.shape[1]
+        live = tables[:, 0] != NULL_BLOCK
+        valid = (jnp.arange(S)[None] < n_valid[:, None]) & live[:, None]
+        logits, pools = self._paged_forward(
+            params, tokens, pools, tables, lengths, valid)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
+
+    def _prewarm_mixed(self) -> None:
+        """Compile the fused mixed dispatch at every (slot bucket,
+        chunk bucket, table bucket) triple it can ever run at —
+        all-null tables, zero valid lanes, so nothing real is touched.
+        The chunk-bucket axis is capped by the smaller of the chunk
+        size and the prefill budget (a single row can never carry more
+        lanes than either allows).  Same argument as _prewarm_verify:
+        bucket visits depend on arrival timing, not just the trace
+        envelope, so the zero-recompile contract is paid up front."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        serve = self.serve
+        s_cap = _bucket(min(serve.prefill_chunk, serve.prefill_budget),
+                        serve.prefill_chunk)
+        Bb = 1
+        while True:
+            Sb = 1
+            while True:
+                NBb = 1
+                while True:
+                    _, self.pools = self._mixed_fn(
+                        self.params, self.pools,
+                        jnp.asarray(np.zeros((Bb, Sb), np.int32)),
+                        jnp.asarray(np.zeros((Bb,), np.int32)),
+                        jnp.asarray(np.zeros((Bb,), np.int32)),
+                        jnp.asarray(np.zeros((Bb, NBb), np.int32)))
+                    if NBb >= serve.max_blocks_per_seq:
+                        break
+                    NBb = min(NBb * 2, serve.max_blocks_per_seq)
+                if Sb >= s_cap:
+                    break
+                Sb = min(Sb * 2, s_cap)
+            if Bb >= serve.max_slots:
+                break
+            Bb = min(Bb * 2, serve.max_slots)
+
+    def prewarm_decode(self) -> None:
+        """Compile the decode dispatch at every (slot bucket, table
+        bucket) pair it can ever run at — all-null tables, so nothing
+        real is touched.  NOT called at build: the normal engine pays
+        decode compiles in its first (warmup) replay.  Bench control
+        arms call this explicitly when their zero-recompile probe must
+        hold on a wall-clock arrival trace (--serve-mixed-ab's off
+        arm): which (occupancy, table-width) pair a decode step runs
+        at tracks arrival TIMING, and a compile stall in the warmup
+        replay slows it enough to visit different buckets than the
+        stall-free timed replay — the same argument that makes
+        _prewarm_mixed a build-time obligation."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        Bb = 1
+        while True:
+            NBb = 1
+            while True:
+                _, self.pools = self._decode_fn(
+                    self.params, self.pools,
+                    jnp.asarray(np.zeros((Bb,), np.int32)),
+                    jnp.asarray(np.zeros((Bb,), np.int32)),
+                    jnp.asarray(np.zeros((Bb, NBb), np.int32)))
+                if NBb >= self.serve.max_blocks_per_seq:
+                    break
+                NBb = min(NBb * 2, self.serve.max_blocks_per_seq)
+            if Bb >= self.serve.max_slots:
+                break
+            Bb = min(Bb * 2, self.serve.max_slots)
+
     def _prewarm_verify(self) -> None:
         """Compile the verify dispatch at every (slot bucket, table
         bucket) it can ever run at — all-null tables, zero valid lanes,
-        so nothing real is touched.  Unlike the decode path (whose
-        bucket visits depend only on the trace ENVELOPE a warmup replay
-        reproduces), verify-step bucket visits depend on acceptance —
-        token content — so the contract is paid up front."""
+        so nothing real is touched.  Verify-step bucket visits depend
+        on acceptance — token content — so the contract is paid up
+        front.  (Decode bucket visits also drift with arrival timing
+        on wall-clock traces; ``prewarm_decode`` covers that for the
+        bench arms that need it.)"""
         import jax.numpy as jnp
         import numpy as np
 
@@ -678,6 +834,7 @@ class PagedDecodeEngine:
         toks[0, :len(chunk)] = chunk
         tables = self._table_row(seq, self.serve.max_blocks_per_seq)[None]
         self.dispatch_shapes.add(("prefill", sb))
+        self.forward_dispatches += 1
         nxt, self.pools = self._prefill_fn(
             self.params, self.pools, jnp.asarray(toks),
             jnp.asarray(seq.prefilled, jnp.int32),
@@ -716,6 +873,10 @@ class PagedDecodeEngine:
         self._prefill_queue.extend(
             (slot, self.sched.slots[slot]) for slot in admitted)
         self._apply_partial_copies()
+        if self.serve.mixed_batch == "on":
+            # the fused path replaces BOTH the prefill and the decode
+            # phases below; mixed off leaves them byte-for-byte
+            return self._step_mixed()
         emitted = self._advance_prefill()
 
         if self.drafter is not None:
@@ -760,6 +921,7 @@ class PagedDecodeEngine:
             lengths[j] = seq.length - 1
             tables[j] = self._table_row(seq, NBb)
         self.dispatch_shapes.add(("decode", Bb, NBb))
+        self.forward_dispatches += 1
         nxt, self.pools = self._decode_fn(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(tables))
@@ -772,6 +934,131 @@ class PagedDecodeEngine:
             if self._journal is not None:
                 self._journal.record_token(rid, tok)
             self.sched.record_token(slot, tok, self.serve.eos_id)
+        return emitted
+
+    def _step_mixed(self) -> List[Tuple[int, int]]:
+        """The fused replacement for the prefill-then-decode phases
+        (--serve-mixed-batch on): pack the decode row of every live
+        fully-prefilled slot PLUS budget-capped prefill chunks from
+        every mid-prefill sequence the per-step token budget reaches
+        into ONE forward, so decode ITL never stalls behind a long
+        prompt and prefill is no longer serialized to one sequence per
+        step.
+
+        Packing rule: decode rows first (one pending token each), then
+        the prefill queue in FIFO order — each mid-prefill sequence
+        contributes ``min(prefill_chunk, remaining prompt, remaining
+        budget)`` tokens until the budget runs out.  A slot is either
+        decoding or mid-prefill, never both, so the row count is
+        bounded by ``max_slots`` and the dispatch shape set stays
+        (slot bucket) x (chunk bucket) x (table bucket), every triple
+        pre-warmed at build (_prewarm_mixed).
+
+        Every per-row invariant of the unfused loop holds per row:
+        the stale-slot guard (an evicted entry must never prefill the
+        slot's new occupant), ensure_block + CoW over exactly the
+        row's write range, trie insertion at full prefill BEFORE
+        record_token, and the journal's tok-then-end order."""
+        import jax.numpy as jnp
+
+        serve = self.serve
+        emitted: List[Tuple[int, int]] = []
+        # decode rows: the same admission/CoW discipline as the
+        # unfused decode loop, row by row
+        rows = []           # (slot, seq, lane tokens, start, is_prefill)
+        for slot in self.sched.live_slots():
+            seq = self.sched.slots[slot]
+            if seq is None or seq.prefilled < len(seq.request.prompt):
+                continue        # mid-prefill: packed below, not here
+            if not self.sched.ensure_block(slot):
+                self.sched.fail_live(slot, "rejected")
+                continue
+            if not self._ensure_private(slot, seq.length - 1, seq.length):
+                self.sched.fail_live(slot, "rejected")
+                continue
+            rows.append((slot, seq, [self._last_token[slot]],
+                         seq.length - 1, False))
+        # prefill rows: FIFO over the queue under the per-step token
+        # budget — MULTIPLE sequences advance per step, each by at most
+        # one chunk; stale entries (evicted while queued, possibly
+        # re-admitted: the new occupant has its own entry) are dropped,
+        # never prefilled on behalf of
+        budget = serve.prefill_budget
+        for slot, seq in list(self._prefill_queue):
+            if budget <= 0:
+                break
+            if self.sched.slots[slot] is not seq:
+                self._prefill_queue = [
+                    e for e in self._prefill_queue if e[1] is not seq]
+                continue
+            prompt = seq.request.prompt
+            take = min(serve.prefill_chunk,
+                       len(prompt) - seq.prefilled, budget)
+            chunk = prompt[seq.prefilled:seq.prefilled + take]
+            if not self._ensure_private(slot, seq.prefilled,
+                                        seq.prefilled + len(chunk)):
+                # no pool room for a private copy of a shared block
+                # this chunk writes into: fail this one request alone
+                self._prefill_queue = [
+                    e for e in self._prefill_queue if e[1] is not seq]
+                self.sched.fail_live(slot, "rejected")
+                continue
+            budget -= len(chunk)
+            rows.append((slot, seq, list(chunk), seq.prefilled, True))
+        # eviction inside ensure_block/CoW may have retired ANY earlier
+        # row's slot (decode or mid-prefill): keep only rows whose slot
+        # still holds the same sequence — a retired prefill row's queue
+        # entry goes stale and drops on a later step
+        rows = [r for r in rows if self.sched.slots[r[0]] is r[1]]
+        self._track_occupancy()
+        if not rows:
+            return emitted
+        self._progressed = True
+
+        Bb = _bucket(len(rows), serve.max_slots)
+        Sb = _bucket(max(len(r[2]) for r in rows), serve.prefill_chunk)
+        nb = max(len(r[1].block_ids) for r in rows)
+        NBb = _bucket(nb, serve.max_blocks_per_seq)
+        tokens = np.zeros((Bb, Sb), np.int32)
+        lengths = np.zeros((Bb,), np.int32)
+        n_valid = np.zeros((Bb,), np.int32)
+        tables = np.zeros((Bb, NBb), np.int32)
+        for j, (slot, seq, lanes, start, _) in enumerate(rows):
+            tokens[j, :len(lanes)] = lanes
+            n_valid[j] = len(lanes)
+            lengths[j] = start
+            tables[j] = self._table_row(seq, NBb)
+        self.dispatch_shapes.add(("mixed", Bb, Sb, NBb))
+        self.forward_dispatches += 1
+        out, self.pools = self._mixed_fn(
+            self.params, self.pools, jnp.asarray(tokens),
+            jnp.asarray(lengths), jnp.asarray(n_valid),
+            jnp.asarray(tables))
+        out = np.asarray(out)  # graft-lint: sync-ok(the one budgeted bulk sync per mixed dispatch)
+
+        for j, (slot, seq, lanes, start, is_prefill) in enumerate(rows):
+            if is_prefill:
+                seq.prefilled += len(lanes)
+                if seq.prefilled < len(seq.request.prompt):
+                    continue        # still mid-prefill: no token emitted
+                self._prefill_queue = [
+                    e for e in self._prefill_queue if e[1] is not seq]
+                if self.prefix_cache is not None:
+                    # register the fully prefilled prompt's full blocks
+                    # BEFORE record_token can finish the request and
+                    # release them (same order as the unfused path)
+                    self.prefix_cache.insert(seq.request.prompt,
+                                             seq.block_ids)
+            # lane n_valid-1 is exactly what the unfused dispatch
+            # consumes: decode's argmax at its one lane, or prefill's
+            # argmax after the prompt's last position
+            tok = int(out[j, len(lanes) - 1])
+            self._last_token[slot] = tok
+            rid = seq.request.id
+            emitted.append((rid, tok))
+            if self._journal is not None:
+                self._journal.record_token(rid, tok)
+            self.sched.record_token(slot, tok, serve.eos_id)
         return emitted
 
     def _step_verify(self, emitted: List[Tuple[int, int]]) \
@@ -864,6 +1151,7 @@ class PagedDecodeEngine:
             lengths[j] = seq.length - 1
             tables[j] = self._table_row(seq, NBb)
         self.dispatch_shapes.add(("verify", Bb, NBb))
+        self.forward_dispatches += 1
         out, self.pools = self._verify_fn(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(n_valid),
@@ -1036,10 +1324,20 @@ class PagedDecodeEngine:
             "p99_token_latency_ms": float(np.percentile(lat, 99)) * 1e3,
             "evictions": self.sched.evictions,
             "dispatch_shapes": sorted(self.dispatch_shapes),
+            # model-forward dispatch economy: mixed batching's win is
+            # fewer dispatches per emitted token (one fused forward per
+            # step vs prefill + decode), measurable on any backend
+            "forward_dispatches": self.forward_dispatches,
+            "dispatches_per_token": (self.forward_dispatches
+                                     / max(1, total)),
             # final-token emit time per request on the run clock (the
             # same clock as Request.arrival): attained whole-request
             # latency = finish - arrival (serving/loadgen goodput join)
             "request_finish_s": dict(loop.last_emit),
+            # FIRST-token emit time per request on the same clock:
+            # TTFT = first - arrival (the headline latency mixed
+            # batching moves; serving/loadgen joins it as ttft_ms)
+            "request_first_token_s": dict(loop.first_emit),
             "autoscale": (advisor.report() if advisor is not None
                           else None),
         }
@@ -1059,6 +1357,12 @@ class PagedDecodeEngine:
             "occupancy": (self.allocator.num_used
                           / max(1, self.serve.num_blocks - 1)),
             "shed_rate": self.sched.counters["shed"] / seen,
+            # admitted-but-unprefilled prompt tokens, in prefill-chunk
+            # units (~ pending prefill dispatches): queue depth alone
+            # misses head-of-line work already holding slots but not
+            # yet serving (Scheduler.prefill_backlog_tokens)
+            "prefill_backlog": (self.sched.prefill_backlog_tokens
+                                / max(1, self.serve.prefill_chunk)),
         }
 
     def prefix_block(self) -> dict:
@@ -1102,7 +1406,8 @@ class PagedDecodeEngine:
                "prefill": size(self._prefill_fn),
                "cow": size(self._cow_fn),
                "partial": size(self._partial_fn),
-               "verify": size(self._verify_fn)}
+               "verify": size(self._verify_fn),
+               "mixed": size(self._mixed_fn)}
         if self.drafter is not None:
             # a drafter's own jitted dispatches are inside the steady-
             # state loop too — the contract covers them like the

@@ -54,6 +54,12 @@ class EngineLoop:
         engine._journal = journal
         self.token_times: Dict[int, List[float]] = {}
         self.last_emit: Dict[int, float] = {}
+        # first-token emit stamp per request (TTFT = stamp - arrival):
+        # set once at the request's first delivered token; an eviction
+        # clears it — the pre-eviction first token is regenerated, and
+        # only the final delivered stream's timing counts (the same
+        # rule as token_times)
+        self.first_emit: Dict[int, float] = {}
         self.tokens = 0
         self.peak_queue = 0
 
@@ -94,6 +100,7 @@ class EngineLoop:
             if rid in self.last_emit:
                 self.token_times[rid].append(now - self.last_emit[rid])
                 self.last_emit[rid] = now
+                self.first_emit.setdefault(rid, now)
         self.tokens += len(emitted)
         # AFTER the emit accounting: an eviction discards the request's
         # samples so far — including a token emitted this very step
@@ -105,6 +112,7 @@ class EngineLoop:
                 self.journal.record_evict(rid)
             self.token_times[rid] = []
             self.last_emit[rid] = now
+            self.first_emit.pop(rid, None)
         eng.sched.evicted_ids.clear()
         return emitted
 

@@ -461,6 +461,7 @@ def per_request_rows(trace: Trace, result: dict) -> List[dict]:
     work as ``deadline_exceeded``, and the attained-time check also
     catches a completion that slipped past its budget between sweeps."""
     finish = result.get("request_finish_s") or {}
+    first = result.get("request_first_token_s") or {}
     statuses = result.get("statuses") or {}
     outputs = result.get("outputs") or {}
     rows = []
@@ -469,11 +470,18 @@ def per_request_rows(trace: Trace, result: dict) -> List[dict]:
         f = finish.get(i)
         attained = ((f - float(trace.arrivals[i])) * 1e3
                     if f is not None and status == "ok" else None)
+        # time-to-first-token on the same clock — unlike attained_ms
+        # it is kept for any request that streamed at least one token
+        # (a deadline-failed request still made its client wait)
+        t = first.get(i)
+        ttft = ((t - float(trace.arrivals[i])) * 1e3
+                if t is not None else None)
         rows.append({
             "tenant": trace.tenants[i],
             "status": status,
             "tokens": len(outputs.get(i, ())),
             "attained_ms": attained,
+            "ttft_ms": ttft,
             "slo_ms": trace.slos_ms[i],
         })
     return rows

@@ -466,6 +466,13 @@ class ReplicaRouter:
             for rid, t in loop.last_emit.items():
                 self._finish_archive[rid] = max(
                     self._finish_archive.get(rid, t), t)
+            # first-token stamps: the client already HOLDS the donor's
+            # delivered prefix (replay only regenerates what follows),
+            # so a request's TTFT is its EARLIEST incarnation's first
+            # emit (min merge — the mirror of the finish stamps' max)
+            for rid, t in loop.first_emit.items():
+                self._first_archive[rid] = min(
+                    self._first_archive.get(rid, t), t)
             self._tokens_archive[i] += loop.tokens
             self._peak_queue[i] = max(self._peak_queue[i],
                                       loop.peak_queue)
@@ -667,6 +674,7 @@ class ReplicaRouter:
         self._loops: List[Optional[EngineLoop]] = [None] * n
         self._lat_archive: List[List[float]] = [[] for _ in range(n)]
         self._finish_archive: Dict[int, float] = {}
+        self._first_archive: Dict[int, float] = {}
         self._advisor = advisor
         self._tokens_archive = [0] * n
         self._peak_queue = [0] * n
@@ -755,6 +763,7 @@ class ReplicaRouter:
         qd = len(self._pending) + sum(len(b) for b in self._inboxes)
         occ, lf, live = 0.0, 0.0, 0
         shed = 0
+        backlog = 0.0
         for i, eng in enumerate(self.engines):
             shed += int(eng.sched.counters.get("shed", 0))
             if self._loops[i] is None:
@@ -763,13 +772,19 @@ class ReplicaRouter:
             qd += len(eng.sched.waiting)
             occ += eng.allocator.num_used / max(1, eng.serve.num_blocks - 1)
             lf += len(eng.sched.live_slots()) / eng.serve.max_slots
+            # admitted-but-unprefilled work, summed fleet-wide in
+            # prefill-chunk units (the same signal engine.load_signals
+            # feeds a single-engine advisor)
+            backlog += (eng.sched.prefill_backlog_tokens
+                        / max(1, eng.serve.prefill_chunk))
         routed = sum(self._routed)
         self._advisor.observe(
             now,
             queue_depth=qd,
             occupancy=occ / live if live else 0.0,
             live_fraction=lf / live if live else 0.0,
-            shed_rate=shed / max(1, routed))
+            shed_rate=shed / max(1, routed),
+            prefill_backlog=backlog)
 
     def _run_sequential(self, time_fn, t0, guard) -> None:
         while True:
@@ -919,10 +934,13 @@ class ReplicaRouter:
         # finish stamps: dead-incarnation archive, then live loops — a
         # migrated request's survivor stamp (strictly later) wins
         finish = dict(self._finish_archive)
+        first = dict(self._first_archive)
         for lp in self._loops:
             if lp is not None:
                 for rid, t in lp.last_emit.items():
                     finish[rid] = max(finish.get(rid, t), t)
+                for rid, t in lp.first_emit.items():
+                    first[rid] = min(first.get(rid, t), t)
         lat = np.asarray(flat) if flat else np.zeros(1)
         total = sum(len(v) for v in outputs.values())
         # workers are joined, but late probe/failover stragglers may
@@ -962,6 +980,15 @@ class ReplicaRouter:
             "p50_token_latency_ms": float(np.percentile(lat, 50)) * 1e3,
             "p99_token_latency_ms": float(np.percentile(lat, 99)) * 1e3,
             "request_finish_s": finish,
+            "request_first_token_s": first,
+            # dispatch economy summed over the surviving incarnations
+            # (a rebuilt replica restarts its counter — fleet numbers
+            # are a floor, exact when no replica was rebuilt)
+            "forward_dispatches": sum(e.forward_dispatches
+                                      for e in self.engines),
+            "dispatches_per_token": (
+                sum(e.forward_dispatches for e in self.engines)
+                / max(1, total)),
             "autoscale": (self._advisor.report()
                           if self._advisor is not None else None),
         }

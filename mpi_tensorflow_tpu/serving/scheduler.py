@@ -428,6 +428,21 @@ class Scheduler:
         return [i for i, s in enumerate(self.slots)
                 if s is not None and s.prefilled > 0]
 
+    @property
+    def prefill_backlog_tokens(self) -> int:
+        """Prompt tokens of admitted sequences not yet prefilled — the
+        head-of-line work queue depth misses: these sequences hold
+        slots (and pool blocks) but emit nothing until their prefill
+        lands, so load signals counting only the waiting queue
+        under-report pressure exactly when prompts are long.  The
+        autoscale load signal folds this in (engine.load_signals /
+        ScaleAdvisor), and mixed batching drains it under the per-step
+        token budget."""
+        return sum(len(s.request.prompt) - s.prefilled
+                   for s in self.slots
+                   if s is not None
+                   and s.prefilled < len(s.request.prompt))
+
     def _reclaim(self, n: int) -> bool:
         """``can_alloc`` with prefix-cache backpressure: under pool
         pressure, LRU-evict unreferenced cached blocks from the trie

@@ -64,6 +64,14 @@
 #                                   # refcount property test, knob
 #                                   # coupling) next to the v1 cache,
 #                                   # scheduler, and engine pins
+#        T1_FILES="tests/test_mixed_batch.py tests/test_serving.py" \
+#            scripts/t1_guard.sh    # mixed-batch smoke: fused-dispatch
+#                                   # token identity (vs off and vs
+#                                   # generate(), incl. eviction / int8
+#                                   # / TP / replay), the zero-recompile
+#                                   # pin, backlog + TTFT signals — next
+#                                   # to the off-path engine pins it
+#                                   # must leave byte-for-byte alone
 
 set -u
 cd "$(dirname "$0")/.."

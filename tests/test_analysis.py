@@ -254,6 +254,93 @@ def test_prefix_v2_gen_post_init_missing_red():
                and "prefix_gen" in f.message for f in found)
 
 
+def _mixed_batch_tree(*, budget_wired=True, mixed_validated=True):
+    """The mixed-batch knob pair (--serve-mixed-batch/-prefill-budget)
+    as a minimal bridge fixture: one choices-validated string knob plus
+    one range-guarded int knob, breakable one layer at a time."""
+    budget_wire = ("serve_prefill_budget=args.serve_prefill_budget,"
+                   if budget_wired else "")
+    mixed_post = ('if self.mixed_batch not in ("off", "on"):\n'
+                  '                        raise ValueError("bad")'
+                  if mixed_validated else "pass")
+    return {
+        "pkg/cli.py": _src(f"""
+            import argparse
+            from pkg.config import Config
+
+            def build_parser():
+                p = argparse.ArgumentParser()
+                p.add_argument("--serve-mixed-batch",
+                               choices=["off", "on"], default="off")
+                p.add_argument("--serve-prefill-budget",
+                               type=int, default=8)
+                return p
+
+            def config_from_args(args):
+                return Config(
+                    serve_mixed_batch=args.serve_mixed_batch,
+                    {budget_wire})
+
+            def main(argv=None):
+                args = build_parser().parse_args(argv)
+                config = config_from_args(args)
+                if config.serve_mixed_batch not in ("off", "on"):
+                    raise SystemExit("bad mixed")
+                if config.serve_prefill_budget < 1:
+                    raise SystemExit("bad budget")
+                return config
+            """),
+        "pkg/config.py": _src("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class Config:
+                serve_mixed_batch: str = "off"
+                serve_prefill_budget: int = 8
+            """),
+        "pkg/serve.py": _src(f"""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                mixed_batch: str = "off"
+                prefill_budget: int = 8
+
+                def __post_init__(self):
+                    {mixed_post}
+                    if self.prefill_budget < 1:
+                        raise ValueError("bad")
+
+                @classmethod
+                def from_config(cls, cfg):
+                    return cls(mixed_batch=cfg.serve_mixed_batch,
+                               prefill_budget=cfg.serve_prefill_budget)
+
+            def use(serve):
+                return (serve.mixed_batch, serve.prefill_budget)
+            """),
+    }
+
+
+def test_mixed_batch_knob_pair_green():
+    tree = _mixed_batch_tree()
+    assert knob_bridge._find_cli(core.parse_sources(tree)) is not None
+    assert knob_bridge.run(tree) == []
+
+
+def test_mixed_batch_budget_not_wired_red():
+    found = knob_bridge.run(_mixed_batch_tree(budget_wired=False))
+    assert any(f.pass_id == "KNOB-FLAG"
+               and "serve-prefill-budget" in f.message for f in found)
+
+
+def test_mixed_batch_post_init_missing_red():
+    found = knob_bridge.run(_mixed_batch_tree(mixed_validated=False))
+    assert any(f.pass_id == "KNOB-GUARD"
+               and "__post_init__ never validates" in f.message
+               and "mixed_batch" in f.message for f in found)
+
+
 # ---------------------------------------------------------------------
 # recompile-hazard (jit_stability)
 # ---------------------------------------------------------------------
