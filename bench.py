@@ -226,7 +226,7 @@ def measure(batch_size: int = 64, steps: int = 100, warmup: int = 5,
     from mpi_tensorflow_tpu.config import Config
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
     from mpi_tensorflow_tpu.train import loop, step as step_lib
-    from mpi_tensorflow_tpu.utils.timing import time_step_fn
+    from mpi_tensorflow_tpu.utils.profiling import time_step_fn
 
     spec = MODEL_SPECS[model_name]
     in_shape = spec["shape"]
@@ -449,7 +449,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     fault_step: int | None = None,
                     fault_kind: str = "transient",
                     workload: str | None = None,
-                    slo_ms: float | None = None) -> dict:
+                    slo_ms: float | None = None,
+                    trace_mode: str | None = None,
+                    trace_out: str | None = None) -> dict:
     """Continuous-batching serving throughput vs the static-batch
     ``generate`` baseline, on ONE synthetic request trace built by
     ``serving.loadgen`` from a seeded ``WorkloadSpec``.
@@ -578,6 +580,18 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     decoding is excluded at the ServeConfig layer already (both
     replace the decode dispatch).
 
+    Tracing: ``trace_mode`` (--serve-trace: off|on; None = the run
+    Config's default) turns on the serving/tracing layer for every
+    engine this bench builds — request lifecycle spans + the bounded
+    step-phase ring, host clocks only.  The detail gains a
+    ``breakdown`` block (queue/prefill/decode/ttft percentiles
+    recomputed FROM SPANS, cross-checked against the loop's stamps)
+    and a ``trace`` summary; ``trace_out`` (--serve-trace-out) writes
+    the timed run's Chrome trace-event JSON there (open in Perfetto or
+    chrome://tracing).  Off is byte-for-byte the untraced bench:
+    outputs AND detail keys are unchanged (the traced keys simply do
+    not exist).
+
     Distributed serving: ``tp`` shards the timed engine tensor-parallel
     over the first ``tp`` visible devices (serving/tp — the dispatch
     discipline, zero-recompile probes, and every control arm work
@@ -619,6 +633,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                  else cfg.serve_speculative)
     workload = workload if workload is not None else cfg.serve_workload
     slo_ms = slo_ms if slo_ms is not None else cfg.serve_slo_ms
+    trace_mode = trace_mode if trace_mode is not None else cfg.serve_trace
     bcfg = dc.replace(bert.BERT_TINY if tiny else bert.BERT_BASE,
                       dtype=cfg.compute_dtype)
     if spec_mode != "off":
@@ -674,7 +689,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         draft_k=draft_k, draft_auto=draft_auto,
         mixed_batch=mixed, prefill_budget=prefill_budget, tp=tp,
         deadline_ms=deadline_ms, queue_depth=queue_depth,
-        max_evictions=max_evictions, drain_ms=drain_ms)
+        max_evictions=max_evictions, drain_ms=drain_ms,
+        trace=trace_mode, trace_out=trace_out)
     # resolve the unset knob through cfg like every other serve knob,
     # instead of a hardcoded 1 that shadows cfg.serve_replicas
     replicas = replicas if replicas is not None else cfg.serve_replicas
@@ -817,6 +833,35 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         # state on them); deadlines/sessions ride along from the spec
         return trace_b.requests()
 
+    def _trace_detail(run_res: dict) -> dict | None:
+        """The detail's tracing keys for the mode's MAIN run (timed /
+        journaled / fleet): the span-derived ``breakdown`` block
+        cross-checked against the run's own first-token stamps, a
+        small trace summary, and the Chrome trace-event export when
+        ``--serve-trace-out`` names a path.  None (no keys added)
+        when tracing is off — the off detail is byte-for-byte the
+        untraced one."""
+        if serve.trace != "on" or "trace" not in run_res:
+            return None
+        from mpi_tensorflow_tpu.serving import tracing as tracing_lib
+
+        tb = run_res["trace"]
+        chrome = None
+        if serve.trace_out is not None:
+            chrome = tracing_lib.write_chrome_trace(serve.trace_out,
+                                                    tb["replicas"])
+        return {
+            "breakdown": metrics_writer.breakdown_block(
+                tb, stamped_first_s=run_res.get("request_first_token_s")),
+            "trace": {
+                "enabled": True,
+                "spans": len(tb["spans"]),
+                "steps": tb["steps"],
+                "steps_dropped": tb["steps_dropped"],
+                "chrome_trace": chrome,
+            },
+        }
+
     from mpi_tensorflow_tpu.train.preemption import PreemptionGuard
 
     fault_plan = None
@@ -847,7 +892,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         with PreemptionGuard.installed() as guard:
             rr = router.run(todo, guard=guard, journals=journals,
                             replay_pre=pre, fault_plan=fault_plan)
-        return {
+        det = {
             "model": "gpt_tiny" if tiny else "gpt_base",
             "kernel": router.engines[0].kernel,
             "kernel_requested": kernel or cfg.serve_kernel,
@@ -864,6 +909,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "serve_replicas": replicas,
             "serve_workload": workload,
             "serve_slo_ms": slo_ms,
+            "serve_trace": serve.trace,
             # journaled modes replay prior attempts' work into this
             # run's clock — attained latencies would be skewed, so the
             # goodput/autoscale blocks are timed-path-only
@@ -905,6 +951,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "tiny": tiny, "precision": precision,
             "platform": jax.devices()[0].platform,
         }
+        det.update(_trace_detail(rr) or {})
+        return det
 
     if journal is not None:
         # fault-tolerant serve mode: one journaled pass through the
@@ -918,7 +966,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             res = recovery.run_with_replay(
                 lambda: PagedDecodeEngine(model, params, serve),
                 trace(), journal_path=journal, guard=guard)
-        return {
+        det = {
             "model": "gpt_tiny" if tiny else "gpt_base",
             "kernel": res.get("kernel"),
             "kernel_requested": kernel or cfg.serve_kernel,
@@ -937,6 +985,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "serve_replicas": 1,
             "serve_workload": workload,
             "serve_slo_ms": slo_ms,
+            "serve_trace": serve.trace,
             # replayed attempts skew attained latency: timed-path-only
             "goodput": None,
             "autoscale": None,
@@ -968,6 +1017,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "tiny": tiny, "precision": precision,
             "platform": jax.devices()[0].platform,
         }
+        det.update(_trace_detail(res) or {})
+        return det
 
     engine = PagedDecodeEngine(model, params, serve)
     engagement.reset()
@@ -1406,7 +1457,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         loadgen.per_request_rows(trace_b, cb),
         elapsed_s=cb["elapsed_s"])
 
-    return {
+    det = {
         "model": "gpt_tiny" if tiny else "gpt_base",
         "kernel": engine.kernel,
         "kernel_requested": kernel or cfg.serve_kernel,
@@ -1433,6 +1484,7 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "serve_replicas": replicas,
         "serve_workload": workload,
         "serve_slo_ms": slo_ms,
+        "serve_trace": serve.trace,
         "goodput": goodput,
         "autoscale": cb["autoscale"],
         "replicas": replicas_detail,
@@ -1480,6 +1532,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "precision": precision,
         "platform": jax.devices()[0].platform,
     }
+    det.update(_trace_detail(cb) or {})
+    return det
 
 
 def measure_allreduce(payload_mb: float = 25.4, iters: int = 50,
@@ -1868,6 +1922,14 @@ def _stale_score(args, d: dict, item=None):
             return None
         if d.get("serve_slo_ms") != getattr(args, "serve_slo_ms", None):
             return None
+        # tracing stamps host clocks around every dispatch — cheap, but
+        # not free: a record measured under a different trace setting is
+        # a different number (absent keys on old records read as the
+        # pre-tracing default: off)
+        if d.get("serve_trace", "off") != \
+                (getattr(args, "serve_trace", None)
+                 or serve_defaults.serve_trace):
+            return None
         v = d.get("serving_tokens_per_sec")
         if v is None or not (0 < v < 1e6):
             return None
@@ -2050,6 +2112,14 @@ def _report(args, d: dict, stale: bool = False) -> int:
             # half of serving latency tokens/sec cannot see
             out["ttft_p50_ms"] = gp.get("ttft_p50_ms")
             out["ttft_p99_ms"] = gp.get("ttft_p99_ms")
+        bd = d.get("breakdown")
+        if bd and bd.get("enabled"):
+            # THE phase numbers tracing exists for: where the tail of
+            # attained latency actually goes (queued vs prefilling vs
+            # decoding)
+            out["queue_ms_p99"] = bd.get("queue_ms_p99")
+            out["prefill_ms_p99"] = bd.get("prefill_ms_p99")
+            out["decode_ms_p99"] = bd.get("decode_ms_p99")
         _print_json(out)
         return 0
     if args.mode == "decode":
@@ -2210,6 +2280,19 @@ def main(argv=None) -> int:
                          "FINISHED within it, per tenant class "
                          "(default: no SLO — goodput reads as raw "
                          "delivered throughput)")
+    ap.add_argument("--serve-trace", choices=["off", "on"],
+                    default=None,
+                    help="serving mode: request-lifecycle + step-phase "
+                         "tracing (serving/tracing) — the detail gains "
+                         "the span-derived `breakdown` block "
+                         "(queue/prefill/decode/ttft percentiles) and a "
+                         "trace summary; host clocks only, zero device "
+                         "syncs, off is byte-for-byte untraced "
+                         "(default: the run Config's serve_trace)")
+    ap.add_argument("--serve-trace-out", type=str, default=None,
+                    help="serving mode: write the timed run's Chrome "
+                         "trace-event JSON here (open in Perfetto or "
+                         "chrome://tracing); requires --serve-trace on")
     ap.add_argument("--serve-pool-blocks", type=int, default=None,
                     help="serving mode: paged-KV pool blocks (default: "
                          "every slot can reach max length — no "
@@ -2555,6 +2638,13 @@ def main(argv=None) -> int:
             and args.mode != "serving":
         ap.error("--serve-workload/--serve-slo-ms shape the serving "
                  "trace; other modes would silently ignore them")
+    if (args.serve_trace is not None or args.serve_trace_out is not None) \
+            and args.mode != "serving":
+        ap.error("--serve-trace/--serve-trace-out instrument the "
+                 "serving loop; other modes would silently ignore them")
+    if args.serve_trace_out is not None and args.serve_trace != "on":
+        ap.error("--serve-trace-out writes the Chrome trace the tracer "
+                 "collects; it needs --serve-trace on")
     if args.serve_slo_ms is not None and not args.serve_slo_ms > 0:
         ap.error(f"--serve-slo-ms must be > 0, got {args.serve_slo_ms}")
     if (args.serve_fault_replica is not None
@@ -2714,7 +2804,9 @@ def main(argv=None) -> int:
                             fault_step=args.serve_fault_step,
                             fault_kind=args.serve_fault_kind,
                             workload=args.serve_workload,
-                            slo_ms=args.serve_slo_ms)
+                            slo_ms=args.serve_slo_ms,
+                            trace_mode=args.serve_trace,
+                            trace_out=args.serve_trace_out)
         return _report(args, r)
 
     if args.mode == "decode":

@@ -282,6 +282,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "as each request's deadline; the goodput block "
                         "scores tokens/sec from requests that finished "
                         "within it (default: no SLO)")
+    p.add_argument("--serve-trace", choices=["off", "on"],
+                   default=d.serve_trace,
+                   help="serving: request-lifecycle + step-phase "
+                        "tracing (serving/tracing) — host-side span "
+                        "stamps (zero device syncs) plus the "
+                        "`breakdown` latency-attribution block in "
+                        "bench detail; off is byte-for-byte the "
+                        "untraced behavior")
+    p.add_argument("--serve-trace-out", type=str,
+                   default=d.serve_trace_out,
+                   help="serving: write the run's Chrome trace-event "
+                        "JSON here (open in Perfetto or "
+                        "chrome://tracing); requires --serve-trace on")
     p.add_argument("--prng", choices=["threefry", "rbg", "unsafe_rbg"],
                    default=d.prng_impl,
                    help="dropout-mask PRNG: threefry (JAX default, "
@@ -343,6 +356,8 @@ def config_from_args(args) -> Config:
         serve_failover_backoff_ms=args.serve_failover_backoff_ms,
         serve_workload=args.serve_workload,
         serve_slo_ms=args.serve_slo_ms,
+        serve_trace=args.serve_trace,
+        serve_trace_out=args.serve_trace_out,
         prefetch=args.prefetch, remat=args.remat,
         fused_steps=(args.fused_steps if args.fused_steps is not None
                      else (args.log_every if args.sync == "psum" else 1)),
@@ -502,6 +517,15 @@ def main(argv=None) -> int:
         raise SystemExit(
             f"bad --serve-slo-ms {config.serve_slo_ms}: the latency "
             f"budget must be > 0 ms")
+    if config.serve_trace not in ("off", "on"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-trace {config.serve_trace!r}: must be off|on")
+    if config.serve_trace_out is not None and config.serve_trace != "on":
+        raise SystemExit(
+            f"--serve-trace-out {config.serve_trace_out!r} requires "
+            f"--serve-trace on (there is no trace to write otherwise)")
 
     from mpi_tensorflow_tpu.parallel import mesh as meshlib
 

@@ -229,6 +229,16 @@ class Config:
                                   # the goodput metric (tokens/sec
                                   # within budget) keys on it (None =
                                   # no SLO)
+    serve_trace: str = "off"      # request-lifecycle + step-phase
+                                  # tracing (serving/tracing): off | on.
+                                  # off = byte-for-byte untraced
+                                  # behavior; on adds host-side span
+                                  # stamps (zero device syncs) and the
+                                  # `breakdown` block in bench detail
+    serve_trace_out: Optional[str] = None      # Chrome trace-event JSON
+                                  # path (open in Perfetto or
+                                  # chrome://tracing); requires
+                                  # serve_trace=on
 
     # --- checkpointing (absent from the reference; SURVEY.md §5) ---
     checkpoint_dir: Optional[str] = None   # None = checkpointing off

@@ -60,7 +60,19 @@ Six cooperating layers, host-side policy over device-side math:
                      folds the scheduler/router load signals (queue
                      depth, occupancy, shed rate) into per-tick
                      scale-up/down advice under hysteresis + cooldown,
-                     recorded in bench detail.
+                     recorded in bench detail; with tracing on it
+                     consumes the SAME ``TraceBuffer`` step records the
+                     trace exports, so advice is explainable from the
+                     trace.
+- ``tracing``      — host-side request-lifecycle spans (arrive/queued/
+                     admitted/prefill chunks/first token/decode/
+                     terminal, plus eviction and failover-migration
+                     transitions) and a bounded per-step phase timeline
+                     (``TraceBuffer``), fleet-merged across replicas
+                     and incarnations; exports Chrome trace-event JSON
+                     and the bench ``breakdown`` block.  Off = no
+                     tracer object, byte-for-byte untraced; on = host
+                     clocks only, zero device syncs.
 - ``router``       — data-parallel scale-out WITH fleet fault
                      tolerance: N whole engine replicas (each with its
                      own replay journal) behind session-affinity +
@@ -97,3 +109,5 @@ from mpi_tensorflow_tpu.serving.loadgen import (  # noqa: F401
     build_trace, default_tenants, per_request_rows)
 from mpi_tensorflow_tpu.serving.autoscale import (  # noqa: F401
     ScaleAdvisor, ScalePolicy)
+from mpi_tensorflow_tpu.serving.tracing import (  # noqa: F401
+    EngineTracer, Span, TraceBuffer, merge_spans, write_chrome_trace)

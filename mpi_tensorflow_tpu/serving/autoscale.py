@@ -124,6 +124,14 @@ class ScaleAdvisor:
             return self._decide(now_s, "down", load)
         return None
 
+    def observe_step(self, rec: dict) -> Optional[dict]:
+        """One tick from a tracing step record (serving/tracing
+        ``TraceBuffer`` entry): the record's ``signals`` are exactly
+        ``engine.load_signals()`` captured at step end, so with tracing
+        on the advisor and the trace read the SAME observation — advice
+        is explainable by replaying the buffer through this method."""
+        return self.observe(rec["t1"], **rec["signals"])
+
     def _decide(self, now_s: float, action: str, load: float) -> dict:
         before = self.replicas
         self.replicas += 1 if action == "up" else -1

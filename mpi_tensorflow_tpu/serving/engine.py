@@ -57,7 +57,8 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
-from mpi_tensorflow_tpu.serving import paged_cache, scheduler as sched_lib
+from mpi_tensorflow_tpu.serving import paged_cache, \
+    scheduler as sched_lib, tracing
 
 
 @dataclasses.dataclass(frozen=True)
@@ -202,6 +203,15 @@ class ServeConfig:
                                   # doubled per consecutive fault, capped
                                   # at 64x, before the router rebuilds
                                   # the replica and probes it back in
+    trace: str = "off"            # request-lifecycle + step-phase
+                                  # tracing (serving/tracing): "on"
+                                  # builds an EngineTracer at reset and
+                                  # adds the `trace` result block; off
+                                  # is byte-for-byte untraced (the
+                                  # tracer is never constructed)
+    trace_out: Optional[str] = None       # Chrome trace-event JSON path
+                                  # (written by bench after the timed
+                                  # run); requires trace="on"
 
     @classmethod
     def from_config(cls, config, **overrides):
@@ -228,7 +238,9 @@ class ServeConfig:
                     queue_depth=config.serve_queue_depth,
                     max_evictions=config.serve_max_evictions,
                     drain_ms=config.serve_drain_ms,
-                    failover_backoff_ms=config.serve_failover_backoff_ms)
+                    failover_backoff_ms=config.serve_failover_backoff_ms,
+                    trace=config.serve_trace,
+                    trace_out=config.serve_trace_out)
         base.update({k: v for k, v in overrides.items() if v is not None})
         return cls(**base)
 
@@ -307,6 +319,14 @@ class ServeConfig:
                 or (self.drain_ms is not None and self.drain_ms < 0) \
                 or self.failover_backoff_ms <= 0:
             raise ValueError(f"bad fault-tolerance policy: {self}")
+        if self.trace not in ("off", "on"):
+            raise ValueError(
+                f"serve trace must be off|on, got {self.trace!r}")
+        if self.trace_out is not None and self.trace != "on":
+            raise ValueError(
+                "serve trace_out names a Chrome-trace output but trace "
+                "is off — there would be no trace to write; turn trace "
+                "on or drop the path")
         if self.num_blocks - 1 < self.max_blocks_per_seq:
             # a lone max-length sequence must fit, or the scheduler can
             # deadlock with nothing left to evict
@@ -503,6 +523,12 @@ class PagedDecodeEngine:
         # once)
         self.peak_blocks_in_use = 0
         self.peak_live_blocks = 0
+        # tracing resets WITH the engine state (like the pools/trie): a
+        # rebuilt engine is a fresh incarnation whose spans the caller
+        # merges across harvests.  trace off = no tracer object at all,
+        # so every instrumentation site is a `tracer is None` skip
+        self.tracer = (tracing.EngineTracer()
+                       if self.serve.trace == "on" else None)
         self._progressed = False        # did the last step() do any work
         self._journal = None            # set by run(); step() journals a
                                         # token BEFORE record_token so the
@@ -533,6 +559,11 @@ class PagedDecodeEngine:
             self.drafter.release(req.id)
         if self._journal is not None:
             self._journal.record_end(req, status)
+        if self.tracer is not None:
+            # clock-free on purpose: this fires inside step() where no
+            # loop clock is in scope; the tracer queues the transition
+            # and EngineLoop.iterate lands it with the post-step stamp
+            self.tracer.on_terminal(req, status)
 
     # ---------------- jitted device steps ----------------
 
@@ -835,10 +866,15 @@ class PagedDecodeEngine:
         tables = self._table_row(seq, self.serve.max_blocks_per_seq)[None]
         self.dispatch_shapes.add(("prefill", sb))
         self.forward_dispatches += 1
+        tr = self.tracer
+        if tr is not None:
+            _m0 = time.monotonic()
         nxt, self.pools = self._prefill_fn(
             self.params, self.pools, jnp.asarray(toks),
             jnp.asarray(seq.prefilled, jnp.int32),
             jnp.asarray(len(chunk), jnp.int32), jnp.asarray(tables))
+        if tr is not None:
+            tr.dispatch_s += time.monotonic() - _m0
         seq.prefilled += len(chunk)
         if seq.prefilled < len(prompt):
             return []
@@ -852,7 +888,11 @@ class PagedDecodeEngine:
         # the prompt's last position already yields the first output
         # token (exactly generate()'s prefill-argmax), so the slot
         # enters the decode pool one token ahead
+        if tr is not None:
+            _m0 = time.monotonic()
         tok = int(nxt)  # graft-lint: sync-ok(one scalar per admission, not per step)
+        if tr is not None:
+            tr.consume_s += time.monotonic() - _m0
         self._last_token[slot] = tok
         if self._journal is not None:
             self._journal.record_token(seq.request.id, tok)
@@ -922,10 +962,18 @@ class PagedDecodeEngine:
             tables[j] = self._table_row(seq, NBb)
         self.dispatch_shapes.add(("decode", Bb, NBb))
         self.forward_dispatches += 1
+        tr = self.tracer
+        if tr is not None:
+            _m0 = time.monotonic()
         nxt, self.pools = self._decode_fn(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(tables))
+        if tr is not None:
+            _m1 = time.monotonic()
+            tr.dispatch_s += _m1 - _m0
         nxt = np.asarray(nxt)  # graft-lint: sync-ok(the one budgeted bulk sync per decode dispatch)
+        if tr is not None:
+            tr.consume_s += time.monotonic() - _m1
         for j, slot in enumerate(live):
             tok = int(nxt[j])
             self._last_token[slot] = tok
@@ -1030,11 +1078,19 @@ class PagedDecodeEngine:
             tables[j] = self._table_row(seq, NBb)
         self.dispatch_shapes.add(("mixed", Bb, Sb, NBb))
         self.forward_dispatches += 1
+        tr = self.tracer
+        if tr is not None:
+            _m0 = time.monotonic()
         out, self.pools = self._mixed_fn(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(n_valid),
             jnp.asarray(tables))
+        if tr is not None:
+            _m1 = time.monotonic()
+            tr.dispatch_s += _m1 - _m0
         out = np.asarray(out)  # graft-lint: sync-ok(the one budgeted bulk sync per mixed dispatch)
+        if tr is not None:
+            tr.consume_s += time.monotonic() - _m1
 
         for j, (slot, seq, lanes, start, is_prefill) in enumerate(rows):
             if is_prefill:
@@ -1152,11 +1208,19 @@ class PagedDecodeEngine:
             tables[j] = self._table_row(seq, NBb)
         self.dispatch_shapes.add(("verify", Bb, NBb))
         self.forward_dispatches += 1
+        tr = self.tracer
+        if tr is not None:
+            _m0 = time.monotonic()
         out, self.pools = self._verify_fn(
             self.params, self.pools, jnp.asarray(tokens),
             jnp.asarray(lengths), jnp.asarray(n_valid),
             jnp.asarray(tables))
+        if tr is not None:
+            _m1 = time.monotonic()
+            tr.dispatch_s += _m1 - _m0
         out = np.asarray(out)  # graft-lint: sync-ok(the one budgeted bulk sync per verify dispatch)
+        if tr is not None:
+            tr.consume_s += time.monotonic() - _m1
 
         counters = self.sched.counters
         for j, slot in enumerate(live):
@@ -1281,7 +1345,14 @@ class PagedDecodeEngine:
             emitted = loop.iterate(now, time_fn, t0)
             now = time_fn() - t0
             if advisor is not None:
-                advisor.observe(now, **self.load_signals())
+                if self.tracer is not None \
+                        and self.tracer.last_step is not None:
+                    # with tracing on the advisor consumes the SAME
+                    # step record the TraceBuffer holds, so its advice
+                    # is explainable from the trace (ROADMAP item 2)
+                    advisor.observe_step(self.tracer.last_step)
+                else:
+                    advisor.observe(now, **self.load_signals())
             if not emitted and not self._progressed:
                 # no work moved this iteration (idle gap before the next
                 # arrival, or live-but-stalled slots): sleep instead of
@@ -1306,7 +1377,7 @@ class PagedDecodeEngine:
         lat = np.asarray(flat) if flat else np.zeros(1)
         from mpi_tensorflow_tpu.utils.metrics_writer import faults_block
 
-        return {
+        res = {
             "outputs": outputs,
             "statuses": dict(self.sched.statuses),
             "faults": faults_block(self.sched.counters),
@@ -1341,6 +1412,18 @@ class PagedDecodeEngine:
             "autoscale": (advisor.report() if advisor is not None
                           else None),
         }
+        if self.tracer is not None:
+            # the `trace` key exists ONLY with tracing on: the off-path
+            # result dict is byte-for-byte the untraced one
+            h = self.tracer.harvest(elapsed)
+            res["trace"] = {
+                "enabled": True,
+                "replicas": [{"pid": 0, "label": "engine", **h}],
+                "spans": h["spans"],
+                "steps": len(h["steps"]),
+                "steps_dropped": h["steps_dropped"],
+            }
+        return res
 
     def load_signals(self) -> dict:
         """Instantaneous load signals for autoscale advice

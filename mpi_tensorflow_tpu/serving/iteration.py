@@ -35,6 +35,7 @@ accounting here and emits the same tokens.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Dict, List, Optional, Tuple
 
 
@@ -52,6 +53,10 @@ class EngineLoop:
         self.engine = engine
         self.journal = journal
         engine._journal = journal
+        # the engine owns the tracer (rebuilt at engine.reset, like the
+        # pools); None means tracing off and every stamp site below is
+        # a skipped branch — off is byte-for-byte the untraced loop
+        self.tracer = getattr(engine, "tracer", None)
         self.token_times: Dict[int, List[float]] = {}
         self.last_emit: Dict[int, float] = {}
         # first-token emit stamp per request (TTFT = stamp - arrival):
@@ -78,8 +83,16 @@ class EngineLoop:
                 req, deadline=req.arrival + eng.serve.deadline_ms / 1e3)
         if self.journal is not None:
             self.journal.record_submit(req, pre=pre)
+        tr = self.tracer
+        if tr is not None:
+            tr.on_submit(req, replay=req.replayed)
         rej = eng.sched.submit(req, front=front)
         if rej is not None:
+            if tr is not None:
+                # synchronous rejection: the terminal hook already
+                # queued the transition; land it at arrival (zero
+                # queue time — the request never waited)
+                tr.flush_terminals(req.arrival)
             return rej
         self.last_emit[req.id] = req.arrival
         self.token_times[req.id] = []
@@ -93,7 +106,14 @@ class EngineLoop:
         ``engine.step()``, then the emit/eviction accounting.  Returns
         the ``(request id, token)`` pairs emitted."""
         eng = self.engine
+        tr = self.tracer
+        if tr is not None:
+            step_t0 = now
+            tr.begin_step()
+            _m0 = time.monotonic()
         eng.sched.expire_deadlines(now)
+        if tr is not None:
+            tr.sweep_s += time.monotonic() - _m0
         emitted = eng.step()
         now = time_fn() - t0
         for rid, _tok in emitted:
@@ -102,6 +122,11 @@ class EngineLoop:
                 self.last_emit[rid] = now
                 self.first_emit.setdefault(rid, now)
         self.tokens += len(emitted)
+        if tr is not None:
+            # span stamping uses the SAME post-step ``now`` as the
+            # latency clock above, so span TTFT == stamped TTFT exactly
+            tr.observe(eng.sched.occupied_view(),
+                       {rid for rid, _tok in emitted}, now)
         # AFTER the emit accounting: an eviction discards the request's
         # samples so far — including a token emitted this very step
         # (prefill-final then evicted by a later slot's ensure_block);
@@ -113,7 +138,14 @@ class EngineLoop:
             self.token_times[rid] = []
             self.last_emit[rid] = now
             self.first_emit.pop(rid, None)
+            if tr is not None:
+                tr.on_evict(rid, now)
         eng.sched.evicted_ids.clear()
+        if tr is not None:
+            # terminals land AFTER first-token stamping (same ``now``),
+            # so ``terminal >= first_token`` holds within every span
+            tr.flush_terminals(now)
+            tr.end_step(step_t0, now, len(emitted), eng.load_signals())
         return emitted
 
     def latencies(self) -> List[float]:

@@ -464,6 +464,11 @@ def per_request_rows(trace: Trace, result: dict) -> List[dict]:
     first = result.get("request_first_token_s") or {}
     statuses = result.get("statuses") or {}
     outputs = result.get("outputs") or {}
+    # with tracing on the run result carries lifecycle spans
+    # (serving/tracing) — join the phase attribution onto each row so
+    # a per-tenant SLO miss can be read as queueing vs prefill vs
+    # decode without opening the Chrome trace
+    spans = (result.get("trace") or {}).get("spans") or {}
     rows = []
     for i in range(len(trace.prompts)):
         status = statuses.get(i, "missing")
@@ -476,12 +481,18 @@ def per_request_rows(trace: Trace, result: dict) -> List[dict]:
         t = first.get(i)
         ttft = ((t - float(trace.arrivals[i])) * 1e3
                 if t is not None else None)
-        rows.append({
+        row = {
             "tenant": trace.tenants[i],
             "status": status,
             "tokens": len(outputs.get(i, ())),
             "attained_ms": attained,
             "ttft_ms": ttft,
             "slo_ms": trace.slos_ms[i],
-        })
+        }
+        sp = spans.get(i)
+        if sp is not None:
+            row["queue_ms"] = sp["queue_s"] * 1e3
+            row["prefill_ms"] = sp["prefill_s"] * 1e3
+            row["decode_ms"] = sp["decode_s"] * 1e3
+        rows.append(row)
     return rows

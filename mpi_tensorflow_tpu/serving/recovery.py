@@ -347,6 +347,7 @@ def run_with_replay(make_engine: Callable[[], "object"],
     if journal is None:
         journal = ReplayJournal(journal_path)
     totals: Counter = Counter()
+    crash_harvests: List[dict] = []
     attempt = 0
     while True:
         engine = None
@@ -363,6 +364,13 @@ def run_with_replay(make_engine: Callable[[], "object"],
         except Exception as e:     # noqa: BLE001 — classified right below
             if engine is not None:
                 totals.update(engine.sched.counters)
+                if getattr(engine, "tracer", None) is not None:
+                    # freeze the dying incarnation's spans at the last
+                    # stamp its tracer saw; merged below so a replayed
+                    # request's phase time accumulates across restarts
+                    # instead of resetting (the failover span contract)
+                    crash_harvests.append(
+                        engine.tracer.harvest(reason="crashed"))
             if not is_transient_fn(e) or attempt >= max_restarts:
                 raise
             attempt += 1
@@ -371,6 +379,24 @@ def run_with_replay(make_engine: Callable[[], "object"],
             if backoff_seconds > 0:
                 time.sleep(backoff_seconds)
     totals["replays"] += attempt
+    if crash_harvests and res.get("trace") is not None:
+        # spans survive crash/replay the same way they survive fleet
+        # failover: merge every crashed incarnation's harvest with the
+        # final attempt's, summing phase accumulators per request
+        from mpi_tensorflow_tpu.serving.tracing import merge_spans
+
+        harvests = crash_harvests + [res["trace"]["replicas"][0]]
+        spans = merge_spans(harvests)
+        steps = [r for h in harvests for r in h["steps"]]
+        dropped = sum(h["steps_dropped"] for h in harvests)
+        res["trace"] = {
+            "enabled": True,
+            "replicas": [{"pid": 0, "label": "engine", "spans": spans,
+                          "steps": steps, "steps_dropped": dropped}],
+            "spans": spans,
+            "steps": len(steps),
+            "steps_dropped": dropped,
+        }
     res["outputs"] = journal.outputs()
     res["statuses"] = dict(journal.statuses)
     # res["tokens"]/elapsed_s/tokens_per_sec stay the FINAL attempt's own

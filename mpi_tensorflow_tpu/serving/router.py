@@ -79,6 +79,7 @@ import numpy as np
 
 from mpi_tensorflow_tpu.serving import recovery as rec_lib
 from mpi_tensorflow_tpu.serving import scheduler as sched_lib
+from mpi_tensorflow_tpu.serving import tracing
 from mpi_tensorflow_tpu.serving.iteration import DrainTracker, EngineLoop
 from mpi_tensorflow_tpu.train import elastic
 
@@ -478,6 +479,16 @@ class ReplicaRouter:
                                       loop.peak_queue)
             self._counter_snap[i].update(eng.sched.counters)
             self._evict_snap[i] += eng.sched.evictions
+            if loop.tracer is not None:
+                # harvest the dead incarnation's trace: open spans are
+                # closed at the failure instant and stamped "migrated",
+                # so the victim's queue/prefill/decode time ACCUMULATES
+                # into the fleet merge instead of resetting when the
+                # replay re-roots it (replay_one resets arrival).
+                # Main-router-thread state, same ownership as
+                # _lat_archive — no lock needed.
+                self._trace_archive[i].append(
+                    loop.tracer.harvest(now, reason="migrated"))
         self._loops[i] = None
         with self._lock:
             self.fleet_counters["failovers"] += 1
@@ -680,6 +691,11 @@ class ReplicaRouter:
         self._peak_queue = [0] * n
         self._counter_snap = [Counter() for _ in range(n)]
         self._evict_snap = [0] * n
+        # trace harvests of dead incarnations, per replica slot — the
+        # _lat_archive idiom: written only by the main router thread at
+        # failover, merged with live loops' harvests at aggregation
+        # (NOT under _lock; span state never crosses threads)
+        self._trace_archive: List[List[dict]] = [[] for _ in range(n)]
         self._drain = DrainTracker(self.engines[0].serve.drain_ms)
         # graft-lint: lock-ok(run setup: worker threads not started yet)
         self._drain_counts: Counter = Counter()
@@ -961,7 +977,7 @@ class ReplicaRouter:
                             if e.prefix_cache is not None),
             router_prefix_hits=int(
                 fleet_counters["router_prefix_hits"]))
-        return {
+        res = {
             "parallel": parallel,
             "outputs": outputs,
             "statuses": statuses,
@@ -991,6 +1007,47 @@ class ReplicaRouter:
                 / max(1, total)),
             "autoscale": (self._advisor.report()
                           if self._advisor is not None else None),
+        }
+        if any(eng.serve.trace == "on" for eng in self.engines):
+            res["trace"] = self._trace_block(elapsed)
+        return res
+
+    def _trace_block(self, elapsed: float) -> dict:
+        """Fleet trace view: per replica slot, merge the dead
+        incarnations' archived harvests with the live loop's harvest
+        (one Chrome-trace pid per replica), then fold every replica
+        into one fleet span map.  ``merge_spans`` SUMS the phase
+        accumulators, so a migrated request's queue time accumulates
+        across donor and survivor incarnations — the failover span
+        contract."""
+        replicas = []
+        all_harvests = []
+        steps = dropped = 0
+        for i in range(len(self.engines)):
+            harvests = list(self._trace_archive[i])
+            lp = self._loops[i]
+            if lp is not None and lp.tracer is not None:
+                harvests.append(lp.tracer.harvest(elapsed))
+            if not harvests:
+                continue
+            step_recs = [rec for h in harvests for rec in h["steps"]]
+            rep_dropped = sum(h["steps_dropped"] for h in harvests)
+            replicas.append({
+                "pid": i,
+                "label": f"replica{i}",
+                "spans": tracing.merge_spans(harvests),
+                "steps": step_recs,
+                "steps_dropped": rep_dropped,
+            })
+            all_harvests.extend(harvests)
+            steps += len(step_recs)
+            dropped += rep_dropped
+        return {
+            "enabled": True,
+            "replicas": replicas,
+            "spans": tracing.merge_spans(all_harvests),
+            "steps": steps,
+            "steps_dropped": dropped,
         }
 
     def compile_counts(self) -> dict:

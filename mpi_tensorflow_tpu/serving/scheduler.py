@@ -428,6 +428,15 @@ class Scheduler:
         return [i for i, s in enumerate(self.slots)
                 if s is not None and s.prefilled > 0]
 
+    def occupied_view(self) -> List[tuple]:
+        """Observation snapshot for the tracing layer: ``(request id,
+        prefilled, generated)`` for every occupied slot — including
+        admitted-but-unprefilled sequences ``live_slots`` skips, which
+        is exactly the admission transition the tracer stamps.  Plain
+        ints, no sequence references escape."""
+        return [(s.request.id, s.prefilled, len(s.generated))
+                for s in self.slots if s is not None]
+
     @property
     def prefill_backlog_tokens(self) -> int:
         """Prompt tokens of admitted sequences not yet prefilled — the

@@ -32,7 +32,7 @@ from mpi_tensorflow_tpu.models import cnn as cnn_lib
 from mpi_tensorflow_tpu.parallel import mesh as meshlib
 from mpi_tensorflow_tpu.train import evaluation, step as step_lib
 from mpi_tensorflow_tpu.utils import logging as logs
-from mpi_tensorflow_tpu.utils.timing import StepTimer
+from mpi_tensorflow_tpu.utils.profiling import StepTimer
 
 
 @dataclasses.dataclass

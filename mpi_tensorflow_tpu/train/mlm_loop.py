@@ -22,7 +22,7 @@ from mpi_tensorflow_tpu.parallel import mesh as meshlib
 from mpi_tensorflow_tpu.train import gspmd
 from mpi_tensorflow_tpu.train import optimizer as opt_lib
 from mpi_tensorflow_tpu.utils import logging as logs
-from mpi_tensorflow_tpu.utils.timing import StepTimer
+from mpi_tensorflow_tpu.utils.profiling import StepTimer
 
 
 @dataclasses.dataclass

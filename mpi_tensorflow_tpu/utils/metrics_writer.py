@@ -330,6 +330,82 @@ def goodput_block(rows, *, elapsed_s: float, enabled=None) -> dict:
     return block
 
 
+#: canonical phase-attribution keys — THE shape of the ``breakdown``
+#: block bench detail carries with --serve-trace on (serving/tracing
+#: spans).  queue/prefill/decode percentiles are recomputed FROM SPANS
+#: (not from the engine's scalar stamps); the two ``*_max_delta_ms``
+#: keys are the cross-checks that pin the span clock to the stamped
+#: clock: phase times sum to the attained whole-request latency, and
+#: span TTFT equals the stamped first-token time.
+BREAKDOWN_KEYS = ("enabled", "requests", "queue_ms_p50", "queue_ms_p99",
+                  "prefill_ms_p50", "prefill_ms_p99", "decode_ms_p50",
+                  "decode_ms_p99", "ttft_ms_p50", "ttft_ms_p99",
+                  "phase_sum_vs_attained_max_delta_ms",
+                  "ttft_vs_stamp_max_delta_ms", "steps", "steps_dropped")
+
+
+def breakdown_block(trace, *, enabled=None, stamped_first_s=None) -> dict:
+    """Aggregate a serving ``trace`` result block (engine/router
+    ``res["trace"]``: fleet-merged spans + step-ring accounting) into
+    the canonical ``breakdown`` block — per-phase latency percentiles
+    over requests that finished ``ok``, with the span-vs-stamp
+    consistency deltas.
+
+    ``stamped_first_s`` is the run's ``request_first_token_s`` map;
+    when given, ``ttft_vs_stamp_max_delta_ms`` reports the worst
+    disagreement between a span's first-token stamp and the loop's —
+    the loop stamps both from the same post-step clock read, so this
+    should be ~0 and a drift means an instrumentation bug.  Keys are
+    always exactly ``BREAKDOWN_KEYS`` (zeros when disabled/empty)."""
+    if enabled is None:
+        enabled = bool(trace) and bool(trace.get("enabled"))
+    out = {k: 0.0 for k in BREAKDOWN_KEYS}
+    out["enabled"] = bool(enabled)
+    out["requests"] = 0
+    out["steps"] = 0
+    out["steps_dropped"] = 0
+    if not enabled or not trace:
+        return out
+    spans = trace.get("spans", {})
+    ok = [d for d in spans.values() if d.get("status") == "ok"]
+    queue = [d["queue_s"] * 1e3 for d in ok]
+    prefill = [d["prefill_s"] * 1e3 for d in ok]
+    decode = [d["decode_s"] * 1e3 for d in ok]
+    ttft = [(d["first_token"] - d["arrive"]) * 1e3 for d in ok
+            if d.get("first_token") is not None]
+    phase_delta = [abs((d["queue_s"] + d["prefill_s"] + d["decode_s"])
+                       - (d["terminal"] - d["arrive"])) * 1e3
+                   for d in ok if d.get("terminal") is not None
+                   # a migrated span's attained latency includes the
+                   # inter-incarnation replay gap its phase clocks
+                   # deliberately exclude — the sum contract holds per
+                   # incarnation, so check single-incarnation spans
+                   if d.get("incarnations", 1) == 1 and not d["replays"]]
+    stamp_delta = [abs(d["first_token"] - stamped_first_s[d["rid"]]) * 1e3
+                   for d in ok
+                   if stamped_first_s is not None
+                   and d.get("first_token") is not None
+                   and d["rid"] in stamped_first_s]
+    out.update({
+        "requests": len(ok),
+        "queue_ms_p50": round(_percentile(queue, 0.5), 3),
+        "queue_ms_p99": round(_percentile(queue, 0.99), 3),
+        "prefill_ms_p50": round(_percentile(prefill, 0.5), 3),
+        "prefill_ms_p99": round(_percentile(prefill, 0.99), 3),
+        "decode_ms_p50": round(_percentile(decode, 0.5), 3),
+        "decode_ms_p99": round(_percentile(decode, 0.99), 3),
+        "ttft_ms_p50": round(_percentile(ttft, 0.5), 3),
+        "ttft_ms_p99": round(_percentile(ttft, 0.99), 3),
+        "phase_sum_vs_attained_max_delta_ms": round(
+            max(phase_delta), 3) if phase_delta else 0.0,
+        "ttft_vs_stamp_max_delta_ms": round(
+            max(stamp_delta), 3) if stamp_delta else 0.0,
+        "steps": int(trace.get("steps", 0)),
+        "steps_dropped": int(trace.get("steps_dropped", 0)),
+    })
+    return out
+
+
 def write_faults(writer: MetricsWriter, counters, step: int = 0,
                  prefix: str = "serving/faults/") -> dict:
     """Stream the normalized faults block through a MetricsWriter (one

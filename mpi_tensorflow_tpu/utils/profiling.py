@@ -1,15 +1,28 @@
-"""Tracing / profiling subsystem.
+"""Profiling + host-side step timing — the repo's ONE timing idiom.
 
 The reference has none — its only instrumentation is a commented-out
 wall-clock timer (mpipy.py:78) and the 50-step print trace (SURVEY.md §5
-tracing row).  Here profiling is a first-class utility:
+tracing row).  Here measurement is a first-class utility:
 
 - ``trace(dir)``: context manager around ``jax.profiler`` — produces an
   XPlane/TensorBoard trace of device + host activity;
 - ``annotate(name)``: names a region so it shows up in the trace timeline
   (host side) and, via ``jax.named_scope``, in the compiled HLO;
 - ``device_memory_stats()``: per-device HBM usage snapshot, for finding the
-  working-set the rematerialization knobs should target.
+  working-set the rematerialization knobs should target;
+- ``StepTimer`` / ``time_step_fn``: warmup-skipping wall-clock step
+  timers for the TRAIN loops and bench — JAX dispatch is asynchronous,
+  so both block on the final output (``block_until_ready``) and
+  amortize over many steps.  Measurement rule from BASELINE.md:
+  evaluation stays OFF the timed path (the reference's accidental
+  every-step full-test eval at mpipy.py:86 is not replicated in what
+  we time).
+
+The SERVING side has its own timing layer — ``serving/tracing``
+stamps request-lifecycle spans and per-step phase durations on the
+serve loop's existing host clocks (it must never block on device
+output the way ``time_step_fn`` deliberately does).  Train/bench time
+here; serving traces there; nothing else reads a clock.
 
 Wired into the CLI as ``--profile-dir`` (cli.py).
 """
@@ -17,6 +30,8 @@ Wired into the CLI as ``--profile-dir`` (cli.py).
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+import time
 from typing import Iterator, Optional
 
 
@@ -42,6 +57,57 @@ def annotate(name: str) -> Iterator[None]:
 
     with jax.profiler.TraceAnnotation(name), jax.named_scope(name):
         yield
+
+
+@dataclasses.dataclass
+class StepTimer:
+    """Accumulates steady-state step wall time, skipping warmup steps
+    (compile + first dispatches)."""
+    warmup_steps: int = 2
+    _steps: int = 0
+    _total: float = 0.0
+    _t0: float = 0.0
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, count: int = 1) -> None:
+        dt = time.perf_counter() - self._t0
+        if self.warmup_steps > 0:
+            self.warmup_steps -= count
+            return
+        self._steps += count
+        self._total += dt
+
+    @property
+    def steps_timed(self) -> int:
+        return self._steps
+
+    @property
+    def mean_step_seconds(self) -> float:
+        return self._total / self._steps if self._steps else float("nan")
+
+    def images_per_sec(self, batch_size: int) -> float:
+        s = self.mean_step_seconds
+        return batch_size / s if s == s and s > 0 else float("nan")
+
+
+def time_step_fn(step_fn, state, make_args, iters: int = 20, warmup: int = 3):
+    """Benchmark a train step that donates (and returns) its state.
+
+    ``make_args(i)`` supplies the per-call non-state arguments.  Returns
+    ``(mean_seconds_per_step, final_state)``.
+    """
+    import jax
+
+    for i in range(warmup):
+        state, metrics = step_fn(state, *make_args(i))
+    jax.block_until_ready(state)
+    t0 = time.perf_counter()
+    for i in range(iters):
+        state, metrics = step_fn(state, *make_args(i))
+    jax.block_until_ready(state)
+    return (time.perf_counter() - t0) / iters, state
 
 
 def device_memory_stats() -> list:

@@ -72,6 +72,14 @@
 #                                   # pin, backlog + TTFT signals — next
 #                                   # to the off-path engine pins it
 #                                   # must leave byte-for-byte alone
+#        T1_FILES="tests/test_tracing.py tests/test_analysis.py" \
+#            scripts/t1_guard.sh    # tracing smoke: off-path token
+#                                   # identity, span state machine,
+#                                   # ring bound, Chrome JSON schema,
+#                                   # breakdown-vs-stamp TTFT, failover
+#                                   # span accumulation — plus the
+#                                   # graft-lint knob/HOST-SYNC
+#                                   # fixtures for --serve-trace
 
 set -u
 cd "$(dirname "$0")/.."

@@ -341,6 +341,97 @@ def test_mixed_batch_post_init_missing_red():
                and "mixed_batch" in f.message for f in found)
 
 
+def _trace_knob_tree(*, out_wired=True, out_validated=True):
+    """The tracing knob pair (--serve-trace/--serve-trace-out) as a
+    minimal bridge fixture: one choices-validated mode knob plus one
+    path knob whose only semantic guard is the coupling check
+    (trace_out requires trace on), breakable one layer at a time."""
+    out_wire = ("serve_trace_out=args.serve_trace_out,"
+                if out_wired else "")
+    out_post = ('if self.trace_out is not None and self.trace != "on":\n'
+                '                        raise ValueError("bad")'
+                if out_validated else "pass")
+    return {
+        "pkg/cli.py": _src(f"""
+            import argparse
+            from pkg.config import Config
+
+            def build_parser():
+                p = argparse.ArgumentParser()
+                p.add_argument("--serve-trace",
+                               choices=["off", "on"], default="off")
+                p.add_argument("--serve-trace-out",
+                               type=str, default=None)
+                return p
+
+            def config_from_args(args):
+                return Config(
+                    serve_trace=args.serve_trace,
+                    {out_wire})
+
+            def main(argv=None):
+                args = build_parser().parse_args(argv)
+                config = config_from_args(args)
+                if config.serve_trace not in ("off", "on"):
+                    raise SystemExit("bad trace")
+                if config.serve_trace_out is not None:
+                    if config.serve_trace != "on":
+                        raise SystemExit("out needs trace on")
+                return config
+            """),
+        "pkg/config.py": _src("""
+            import dataclasses
+            from typing import Optional
+
+            @dataclasses.dataclass
+            class Config:
+                serve_trace: str = "off"
+                serve_trace_out: Optional[str] = None
+            """),
+        "pkg/serve.py": _src(f"""
+            import dataclasses
+            from typing import Optional
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                trace: str = "off"
+                trace_out: Optional[str] = None
+
+                def __post_init__(self):
+                    if self.trace not in ("off", "on"):
+                        raise ValueError("bad")
+                    {out_post}
+
+                @classmethod
+                def from_config(cls, cfg):
+                    return cls(trace=cfg.serve_trace,
+                               trace_out=cfg.serve_trace_out)
+
+            def use(serve):
+                return (serve.trace, serve.trace_out)
+            """),
+    }
+
+
+def test_trace_knob_pair_green():
+    tree = _trace_knob_tree()
+    assert knob_bridge._find_cli(core.parse_sources(tree)) is not None
+    assert knob_bridge.run(tree) == []
+
+
+def test_trace_out_not_wired_red():
+    found = knob_bridge.run(_trace_knob_tree(out_wired=False))
+    assert any(f.pass_id == "KNOB-FLAG"
+               and "serve-trace-out" in f.message for f in found)
+
+
+def test_trace_out_post_init_missing_red():
+    found = knob_bridge.run(_trace_knob_tree(out_validated=False))
+    assert any(f.pass_id == "KNOB-GUARD"
+               and "__post_init__ never validates" in f.message
+               and "trace_out" in f.message for f in found)
+
+
 # ---------------------------------------------------------------------
 # recompile-hazard (jit_stability)
 # ---------------------------------------------------------------------
@@ -509,6 +600,18 @@ def test_host_sync_rebinding_clears_taint():
                 nxt = [1, 2, 3]
                 return int(nxt[0])""")
     assert host_sync.run(tree) == []
+
+
+def test_host_sync_trace_stamp_red():
+    # a span-stamping callback must not smuggle a device sync: reading
+    # the dispatched output to decorate a trace event blocks the serve
+    # loop on the device — tracing's contract is host clocks ONLY
+    tree = _hot_module("""nxt = self._decode_fn(tokens)
+                self.tracer.event(float(nxt), "first_token")
+                return nxt""")
+    found = host_sync.run(tree)
+    assert _ids(found) == ["HOST-SYNC"]
+    assert "float()" in found[0].message
 
 
 def test_host_sync_cold_namespace_green():
