@@ -431,6 +431,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                     kernel: str | None = None,
                     kernel_ab: bool = False,
                     kv_dtype: str | None = None,
+                    kv_group: int | None = None,
+                    kv_tier: str | None = None,
                     kv_ab: bool = False,
                     prefix_cache: str | None = None,
                     prefix_tokens: int = 0,
@@ -508,10 +510,16 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     the fused kernel on real hardware.
 
     KV quantization: ``kv_dtype`` picks the paged-pool storage format
-    (--serve-kv-dtype: fp32|int8; None = the run Config's default) —
-    int8 stores symmetric-absmax codes with per-(block, head, slot)
-    fp32 row scales, dequantized inside the attention consume paths.
-    ``kv_ab`` replays the SAME trace under BOTH formats (each arm with
+    (--serve-kv-dtype: fp32|int8|int4; None = the run Config's
+    default) — int8 stores symmetric-absmax codes with per-(block,
+    head, slot) fp32 row scales, int4 packs two codes per byte with
+    per-``kv_group``-wide fp32 group scales (--serve-kv-group), both
+    dequantized inside the attention consume paths.  ``kv_tier``
+    (--serve-kv-tier: off|host) demotes cold prefix-cache blocks to
+    host RAM on eviction and promotes them back on a prefix match —
+    it rides the prefix-cache-on multi-turn path and reports in the
+    ``tier`` block.  ``kv_ab`` replays the SAME trace under the
+    quantized rung and its fp32 reference (each arm with
     its own untimed warmup and zero-recompile probe, mirroring
     ``kernel_ab`` and mutually exclusive with it and every other A/B
     or control-arm mode — one comparison, one variable) and emits the
@@ -683,7 +691,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
     serve = ServeConfig.from_config(
         cfg, num_blocks=pool_blocks, block_size=block_size,
         max_slots=max_slots, max_seq_len=max_seq_len, kernel=kernel,
-        kv_dtype=kv_dtype, prefix_cache=prefix_cache,
+        kv_dtype=kv_dtype, kv_group=kv_group, kv_tier=kv_tier,
+        prefix_cache=prefix_cache,
         prefix_gen=prefix_gen, prefix_route=prefix_route,
         speculative=speculative,
         draft_k=draft_k, draft_auto=draft_auto,
@@ -898,6 +907,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "kernel_requested": kernel or cfg.serve_kernel,
             "roofline": _roofline(router.engines[0].kernel),
             "serve_kv_dtype": serve.kv_dtype,
+            "serve_kv_group": serve.kv_group,
+            "serve_kv_tier": serve.kv_tier,
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
             "serve_prefix_gen": serve.prefix_gen,
@@ -972,6 +983,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "kernel_requested": kernel or cfg.serve_kernel,
             "roofline": _roofline(res.get("kernel")),
             "serve_kv_dtype": serve.kv_dtype,
+            "serve_kv_group": serve.kv_group,
+            "serve_kv_tier": serve.kv_tier,
             "prefix": res.get("prefix"),
             "serve_prefix_cache": serve.prefix_cache,
             "serve_prefix_tokens": prefix_tokens,
@@ -1082,9 +1095,12 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         # own zero-recompile probe — quantized pools must honor the
         # bucket contract too (codes and scale siblings are fixed-shape
         # engine state, so nothing about the dispatch shapes changes).
-        # Arms are oriented fp32=reference / int8=quantized regardless
-        # of which one the timed engine ran.
-        other_dt = "int8" if serve.kv_dtype == "fp32" else "fp32"
+        # Arms are oriented fp32=reference / quantized regardless of
+        # which one the timed engine ran; the quantized rung is the
+        # run's --serve-kv-dtype when it is already below fp32, else
+        # int8 (the ladder's first rung).
+        quant_dt = serve.kv_dtype if serve.kv_dtype != "fp32" else "int8"
+        other_dt = "fp32" if serve.kv_dtype != "fp32" else quant_dt
         eng2 = PagedDecodeEngine(
             model, params, dc.replace(serve, kv_dtype=other_dt))
         eng2.run(trace())
@@ -1092,23 +1108,30 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         eng2.reset()
         cb2 = eng2.run(trace())
         s2 = eng2.compile_counts()
-        cb_fp32, cb_int8 = ((cb, cb2) if serve.kv_dtype == "fp32"
-                            else (cb2, cb))
+        cb_fp32, cb_q = ((cb, cb2) if serve.kv_dtype == "fp32"
+                         else (cb2, cb))
         # positionwise greedy agreement over the whole trace; a length
         # mismatch counts every unpaired position as a mismatch (the
         # honest denominator — early divergence must not shrink it)
         matched = compared = 0
         for rid, ref_out in cb_fp32["outputs"].items():
-            q_out = cb_int8["outputs"].get(rid, [])
+            q_out = cb_q["outputs"].get(rid, [])
             compared += max(len(ref_out), len(q_out))
             matched += sum(a == b for a, b in zip(ref_out, q_out))
         # bytes per pool block across all layers: fp32 stores K and V
         # rows at the compute dtype's width; int8 stores 1-byte codes
-        # plus one fp32 scale per (head, slot) row — the +4/D overhead
+        # plus one fp32 scale per (head, slot) row — the +4/D
+        # overhead; int4 packs two codes per byte (D/2) plus one fp32
+        # scale per g_eff-wide group along the head dim — +4/g_eff
         itemsize = int(jnp.dtype(cfg.compute_dtype).itemsize)
         rows = bcfg.heads * serve.block_size          # rows per block
         fp32_block = 2 * rows * bcfg.head_dim * itemsize * bcfg.layers
-        int8_block = 2 * rows * (bcfg.head_dim + 4) * bcfg.layers
+        if quant_dt == "int4":
+            g_eff = min(serve.kv_group, bcfg.head_dim)
+            q_row = bcfg.head_dim // 2 + 4 * (bcfg.head_dim // g_eff)
+        else:
+            q_row = bcfg.head_dim + 4
+        q_block = 2 * rows * q_row * bcfg.layers
         # decode-bandwidth roofline at the streaming (pallas) cost
         # model: one read of the live context's K and V rows per token
         mean_ctx = float(np.mean([len(p) + t + 1
@@ -1116,20 +1139,19 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
                                   for t in range(o)]))
         fp32_bpt = bcfg.layers * 2 * bcfg.heads * bcfg.head_dim \
             * itemsize * mean_ctx
-        int8_bpt = bcfg.layers * 2 * bcfg.heads * (bcfg.head_dim + 4) \
-            * mean_ctx
+        q_bpt = bcfg.layers * 2 * bcfg.heads * q_row * mean_ctx
         kv_detail = {
             **metrics_writer.kv_quant_block(
-                kv_dtype="int8",
+                kv_dtype=quant_dt,
                 matched_tokens=matched, compared_tokens=compared,
-                block_bytes_ref=fp32_block, block_bytes=int8_block,
+                block_bytes_ref=fp32_block, block_bytes=q_block,
                 num_blocks=serve.num_blocks,
                 peak_live_blocks_ref=cb_fp32["peak_live_blocks"],
-                peak_live_blocks=cb_int8["peak_live_blocks"],
+                peak_live_blocks=cb_q["peak_live_blocks"],
                 bytes_per_decode_token_ref=fp32_bpt,
-                bytes_per_decode_token=int8_bpt),
+                bytes_per_decode_token=q_bpt),
             "tokens_per_sec": {"fp32": cb_fp32["tokens_per_sec"],
-                               "int8": cb_int8["tokens_per_sec"]},
+                               quant_dt: cb_q["tokens_per_sec"]},
             "ab_zero_recompile": (w2 == s2
                                   if all(v is not None for v in
                                          {**w2, **s2}.values()) else None),
@@ -1148,7 +1170,8 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         eng_off = PagedDecodeEngine(
             model, params, dc.replace(serve, prefix_cache="off",
                                       prefix_gen="off",
-                                      prefix_route="off"))
+                                      prefix_route="off",
+                                      kv_tier="off"))
         eng_off.run(trace())
         eng_off.reset()
         off = eng_off.run(trace())
@@ -1206,6 +1229,12 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
             "requests_per_turn": num_requests,
             "prefix_on": on_r["prefix"],
             "prefix_off": off_r["prefix"],
+            # with --serve-kv-tier host the multi-turn trace is where
+            # promotion fires: turn-1 leaves demoted under pool
+            # pressure are re-admitted when the follow-up turn matches
+            # them, so this run's tier counters — not the single-turn
+            # main trace's — carry the prefill_tokens_saved_tier win
+            "tier": on_r.get("tier"),
             # THE gen-arm acceptance numbers: generated blocks actually
             # entered the trie, and the follow-up turn's reuse beats the
             # prompt-only (v1) baseline strictly
@@ -1465,6 +1494,9 @@ def measure_serving(num_requests: int = 24, rate_rps: float = 4.0,
         "kernel_ab": ab,
         "kv_quant": kv_detail,
         "serve_kv_dtype": serve.kv_dtype,
+        "serve_kv_group": serve.kv_group,
+        "serve_kv_tier": serve.kv_tier,
+        "tier": cb.get("tier"),
         "prefix": prefix_detail,
         "prefix_gen": gen_detail,
         "prefix_route": route_detail,
@@ -1832,6 +1864,19 @@ def _stale_score(args, d: dict, item=None):
         if d.get("serve_kv_dtype", "fp32") != \
                 (getattr(args, "serve_kv_dtype", None)
                  or serve_defaults.serve_kv_dtype):
+            return None
+        # the quantization group width changes int4 scale traffic and
+        # the codes themselves, and host tiering changes the multi-turn
+        # prefill numbers — both are different measurements (absent
+        # keys on old records read as the pre-ladder defaults: group
+        # 32, tiering off)
+        if d.get("serve_kv_group", 32) != \
+                (getattr(args, "serve_kv_group", None)
+                 or serve_defaults.serve_kv_group):
+            return None
+        if d.get("serve_kv_tier", "off") != \
+                (getattr(args, "serve_kv_tier", None)
+                 or serve_defaults.serve_kv_tier):
             return None
         # prefix sharing changes both the trace (the shared system
         # prompt) and the pool behavior — a record measured under a
@@ -2327,24 +2372,45 @@ def main(argv=None) -> int:
                          "BOTH kernels (each with its own warmup and "
                          "zero-recompile probe) and emit the "
                          "pallas-vs-xla speedup line")
-    ap.add_argument("--serve-kv-dtype", choices=["fp32", "int8"],
+    ap.add_argument("--serve-kv-dtype", choices=["fp32", "int8", "int4"],
                     default=None,
                     help="serving mode: paged-pool storage format — "
                          "int8 stores symmetric-absmax codes plus "
                          "per-(block, head, slot) fp32 row scales "
-                         "(~4x effective KV capacity at bf16 compute; "
-                         "dequantized inside the attention consume "
-                         "paths, greedy outputs gated on token-match "
-                         "rate vs fp32) (default: the run Config's "
-                         "serve_kv_dtype)")
+                         "(~4x effective KV capacity at bf16 compute); "
+                         "int4 packs two codes per byte plus per-group "
+                         "fp32 scales (--serve-kv-group) with an fp "
+                         "self-residual lane for the in-step token "
+                         "(~6x); both dequantized inside the attention "
+                         "consume paths, greedy outputs gated on "
+                         "token-match rate vs fp32 (default: the run "
+                         "Config's serve_kv_dtype)")
+    ap.add_argument("--serve-kv-group", type=int, default=None,
+                    help="serving mode: int4 quantization group width "
+                         "along the head dim — one fp32 scale per "
+                         "group (clamped to head_dim; smaller = finer "
+                         "scales = more accurate and more scale "
+                         "traffic) (default: the run Config's "
+                         "serve_kv_group)")
+    ap.add_argument("--serve-kv-tier", choices=["off", "host"],
+                    default=None,
+                    help="serving mode: KV block tiering — host "
+                         "demotes cold prefix-cache blocks to host RAM "
+                         "on eviction and promotes them back on a "
+                         "prefix match before first dispatch (requires "
+                         "--serve-prefix-cache on; reported in the "
+                         "tier block) (default: the run Config's "
+                         "serve_kv_tier)")
     ap.add_argument("--serve-kv-ab", action="store_true",
                     help="serving mode: replay the same trace under "
-                         "BOTH pool formats (fp32 and int8, each with "
-                         "its own warmup and zero-recompile probe) and "
-                         "emit the kv_quant block — token-match rate "
-                         "vs fp32, effective-capacity multiplier, "
+                         "BOTH pool formats (the quantized rung from "
+                         "--serve-kv-dtype — int8 when unset/fp32 — "
+                         "and its fp32 reference, each with its own "
+                         "warmup and zero-recompile probe) and emit "
+                         "the kv_quant block — token-match rate vs "
+                         "fp32, effective-capacity multiplier, "
                          "peak-live-blocks delta, and the bytes-per-"
-                         "decode-token roofline at 1 byte/elem")
+                         "decode-token roofline at quantized bytes")
     ap.add_argument("--serve-journal", default=None,
                     help="serving mode: fault-tolerant serve — journal "
                          "each request's prompt + generated prefix here "
@@ -2614,10 +2680,20 @@ def main(argv=None) -> int:
         ap.error("--serve-replicas adds its own routed arm (aggregate "
                  "vs single engine); combine with --serve-kernel-ab/"
                  "--serve-spec-ab/--serve-kv-ab one at a time")
-    if (args.serve_kv_dtype is not None or args.serve_kv_ab) \
+    if (args.serve_kv_dtype is not None or args.serve_kv_ab
+            or args.serve_kv_group is not None
+            or args.serve_kv_tier is not None) \
             and args.mode != "serving":
-        ap.error("--serve-kv-dtype/--serve-kv-ab shape the serving "
-                 "pool; other modes would silently ignore them")
+        ap.error("--serve-kv-dtype/--serve-kv-group/--serve-kv-tier/"
+                 "--serve-kv-ab shape the serving pool; other modes "
+                 "would silently ignore them")
+    if args.serve_kv_group is not None and args.serve_kv_group < 1:
+        ap.error(f"--serve-kv-group must be >= 1, got "
+                 f"{args.serve_kv_group}")
+    if args.serve_kv_tier == "host" and args.serve_prefix_cache != "on":
+        ap.error("--serve-kv-tier host demotes and re-admits blocks "
+                 "through the radix prefix cache's eviction/match "
+                 "hooks; turn it on with --serve-prefix-cache on")
     if args.serve_kv_ab and (args.serve_kernel_ab or args.serve_spec_ab):
         ap.error("--serve-kv-ab, --serve-kernel-ab and --serve-spec-ab "
                  "each replay the trace through their own control arm; "
@@ -2786,6 +2862,8 @@ def main(argv=None) -> int:
                             kernel=args.serve_kernel,
                             kernel_ab=args.serve_kernel_ab,
                             kv_dtype=args.serve_kv_dtype,
+                            kv_group=args.serve_kv_group,
+                            kv_tier=args.serve_kv_tier,
                             kv_ab=args.serve_kv_ab,
                             prefix_cache=args.serve_prefix_cache,
                             prefix_tokens=args.serve_prefix_tokens,

@@ -148,7 +148,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "compile probe passes and the XLA gather path "
                         "otherwise; xla/pallas force one side "
                         "(ops/paged_attention.resolve_kernel)")
-    p.add_argument("--serve-kv-dtype", choices=["fp32", "int8"],
+    p.add_argument("--serve-kv-dtype", choices=["fp32", "int8", "int4"],
                    default=d.serve_kv_dtype,
                    help="serving: paged-pool storage format — fp32 "
                         "keeps the blocks in the model compute dtype "
@@ -157,7 +157,28 @@ def build_parser() -> argparse.ArgumentParser:
                         "per-(block, head, slot) fp32 row scales "
                         "(~4x effective KV capacity), dequantized "
                         "inside the attention consume paths "
-                        "(serving/paged_cache, ops/paged_attention)")
+                        "(serving/paged_cache, ops/paged_attention); "
+                        "int4 nibble-packs two codes per byte with "
+                        "per-group fp32 scales (--serve-kv-group) plus "
+                        "a full-precision self lane for each step's "
+                        "own tokens — the next capacity rung")
+    p.add_argument("--serve-kv-group", type=int, default=d.serve_kv_group,
+                   help="serving: int4 scale-group size along head_dim "
+                        "— one fp32 scale per group (clamped to "
+                        "head_dim on small heads, must divide it); "
+                        "smaller groups quantize tighter at more scale "
+                        "bytes; consumed only with --serve-kv-dtype "
+                        "int4")
+    p.add_argument("--serve-kv-tier", choices=["off", "host"],
+                   default=d.serve_kv_tier,
+                   help="serving: host-RAM KV block tier — host "
+                        "demotes cold prefix-cache blocks to host "
+                        "memory on eviction and promotes them back "
+                        "into fresh device blocks when a later prompt "
+                        "matches their trie path, so multi-turn "
+                        "sessions stop re-paying prefill; requires "
+                        "--serve-prefix-cache on; off is byte-for-byte "
+                        "untiered (serving/paged_cache.HostBlockStore)")
     p.add_argument("--serve-prefix-cache", choices=["off", "on"],
                    default=d.serve_prefix_cache,
                    help="serving: radix prefix cache — on shares "
@@ -339,6 +360,8 @@ def config_from_args(args) -> Config:
         serve_max_seq_len=args.serve_max_seq_len,
         serve_kernel=args.serve_kernel,
         serve_kv_dtype=args.serve_kv_dtype,
+        serve_kv_group=args.serve_kv_group,
+        serve_kv_tier=args.serve_kv_tier,
         serve_prefix_cache=args.serve_prefix_cache,
         serve_prefix_gen=args.serve_prefix_gen,
         serve_prefix_route=args.serve_prefix_route,
@@ -407,12 +430,28 @@ def main(argv=None) -> int:
             f"block-size {config.serve_block_size} (>= 1), max-slots "
             f"{config.serve_max_slots} (>= 1), max-seq-len "
             f"{config.serve_max_seq_len} (>= 1)")
-    if config.serve_kv_dtype not in ("fp32", "int8"):
+    if config.serve_kv_dtype not in ("fp32", "int8", "int4"):
         # argparse choices guard the CLI path; this covers programmatic
         # Config construction routed through main
         raise SystemExit(
             f"bad --serve-kv-dtype {config.serve_kv_dtype!r}: "
-            f"must be fp32|int8")
+            f"must be fp32|int8|int4")
+    if config.serve_kv_group < 1:
+        raise SystemExit(
+            f"bad --serve-kv-group {config.serve_kv_group}: must be "
+            f">= 1 (one fp32 scale per group of head_dim channels)")
+    if config.serve_kv_tier not in ("off", "host"):
+        # argparse choices guard the CLI path; this covers programmatic
+        # Config construction routed through main
+        raise SystemExit(
+            f"bad --serve-kv-tier {config.serve_kv_tier!r}: "
+            f"must be off|host")
+    if config.serve_kv_tier == "host" \
+            and config.serve_prefix_cache == "off":
+        raise SystemExit(
+            "--serve-kv-tier host demotes/promotes radix-trie blocks; "
+            "with --serve-prefix-cache off there are no trie paths to "
+            "key the host store by — turn the cache on or drop the tier")
     if config.serve_prefix_cache not in ("off", "on"):
         # argparse choices guard the CLI path; this covers programmatic
         # Config construction routed through main

@@ -120,7 +120,26 @@ class Config:
                                   # capacity, dequantized inside the
                                   # attention consume paths; greedy
                                   # outputs track fp32 at a token-match-
-                                  # rate gate, not token identity)
+                                  # rate gate, not token identity) |
+                                  # "int4" (two nibble-packed codes per
+                                  # byte + per-group fp32 scales along
+                                  # head_dim + a KIVI fp-residual self
+                                  # lane: the next capacity rung, same
+                                  # token-match-rate gate)
+    serve_kv_group: int = 32      # int4 scale-group size along head_dim
+                                  # (one fp32 scale per group; clamped
+                                  # to head_dim on tiny heads, must
+                                  # divide it).  Consumed only under
+                                  # serve_kv_dtype=int4
+    serve_kv_tier: str = "off"    # host-RAM block tier: "host" demotes
+                                  # cold prefix-cache blocks to host
+                                  # memory on eviction and promotes
+                                  # them back into fresh device blocks
+                                  # when a later prompt matches their
+                                  # trie path (multi-turn sessions stop
+                                  # re-paying prefill); requires
+                                  # serve_prefix_cache=on; "off" is
+                                  # byte-for-byte untiered
     serve_prefix_cache: str = "off"  # radix prefix cache: "on" shares
                                   # already-cached full prompt blocks
                                   # across requests (refcounted, copy-

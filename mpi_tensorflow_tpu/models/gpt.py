@@ -239,7 +239,24 @@ class CausalLm(bert_lib.BertMlm):
                 q = bert_lib.rope(q, pos)
                 k = bert_lib.rope(k, pos)
             q = self._constrain(q, qkv_axes)
-            if "k_scale" in pl:
+            if "k_scale" in pl and pl["k_scale"].ndim == 4:
+                # int4 pool (--serve-kv-dtype int4, 4-d group scales):
+                # group-quantize on store, consume through attend's
+                # dequantizing paths WITH the fp-residual self lane —
+                # the in-register k/v of this step's own tokens give
+                # each query an exact fp score/value for its own
+                # position (KIVI); the fp K/V still never touch the pool
+                pk, ks = paged_ops.write_kv_quant_int4(
+                    pl["k"], pl["k_scale"], k, block_tables, pos, valid)
+                pv, vs = paged_ops.write_kv_quant_int4(
+                    pl["v"], pl["v_scale"], v, block_tables, pos, valid)
+                new_pools.append({"k": pk, "v": pv,
+                                  "k_scale": ks, "v_scale": vs})
+                a = paged_ops.attend(q, pk, pv, block_tables, lengths,
+                                     dt, kernel=kernel,
+                                     k_scale=ks, v_scale=vs,
+                                     k_new=k, v_new=v)
+            elif "k_scale" in pl:
                 # int8 pool (--serve-kv-dtype int8): quantize on store —
                 # codes and per-row scales scatter through the same
                 # block/offset indexing — and consume through attend's

@@ -166,6 +166,106 @@ def dequantize_kv(codes, scale, dt):
     return (codes.astype(jnp.float32) * scale[..., None]).astype(dt)
 
 
+def pack_int4(codes):
+    """Pack int4 codes (values in [-7, 7]) two-per-byte along D.
+
+    codes: (..., D) integer codes.  Split-half layout: byte ``i`` holds
+    code ``i`` in its low nibble and code ``i + D/2`` in its high
+    nibble, so pack/unpack are two cheap vector ops (mask/shift +
+    concat) with no interleaving shuffle — the layout the Pallas
+    kernel's in-register unpack mirrors exactly.  Returns (..., D//2)
+    uint8.
+    """
+    D = codes.shape[-1]
+    lo = codes[..., :D // 2] & 0xF
+    hi = codes[..., D // 2:] & 0xF
+    return (lo | (hi << 4)).astype(jnp.uint8)
+
+
+def unpack_int4(packed):
+    """Invert ``pack_int4``: (..., D//2) uint8 -> (..., D) int32 codes
+    in [-7, 7] (nibbles sign-extend: values > 7 are negatives)."""
+    c = packed.astype(jnp.int32)
+    lo = c & 0xF
+    hi = (c >> 4) & 0xF
+    codes = jnp.concatenate([lo, hi], axis=-1)
+    return codes - jnp.where(codes > 7, 16, 0)
+
+
+def quantize_kv_int4(kv, group: int):
+    """Symmetric absmax int4 quantization with per-GROUP scales along D
+    (the KIVI recipe, arXiv:2402.02750: sub-8-bit KV needs finer scale
+    granularity than a whole row).
+
+    kv: (B, H, S, D) fp K or V vectors.  ``group`` is the --serve-kv-
+    group knob; the effective group is ``min(group, D)`` (so the
+    default 32 stays valid on tiny test heads) and must divide D.
+    Returns ``(packed, scales)``: packed (B, H, S, D//2) uint8
+    (pack_int4 layout), scales (B, H, S, D // g_eff) fp32 with
+    ``scale = max|group| / 7`` (0.0 for an all-zero group).
+
+    Like ``quantize_kv``, the scale granularity never crosses a token
+    row: a group's codes depend only on its OWN fp values, so int4
+    stays write-GRANULARITY-INDEPENDENT — chunked prefill, one-token
+    decode, speculative verify, and journal replay all land
+    bit-identical pool bytes for the same token stream.  Rounding is
+    ``jnp.round`` (round-half-even, deterministic across backends).
+    """
+    x = kv.astype(jnp.float32)
+    D = x.shape[-1]
+    g = min(group, D)
+    xg = x.reshape(x.shape[:-1] + (D // g, g))
+    amax = jnp.max(jnp.abs(xg), axis=-1)              # (B, H, S, G)
+    scale = amax / 7.0
+    safe = jnp.where(scale > 0.0, scale, 1.0)[..., None]
+    codes = jnp.clip(jnp.round(xg / safe), -7, 7).astype(jnp.int32)
+    return pack_int4(codes.reshape(x.shape)), scale
+
+
+def dequantize_kv_int4(packed, scale, dt):
+    """THE int4->fp dequantization (XLA gather path and the Pallas
+    kernel's in-register step share this math): unpack the nibbles,
+    multiply each D-group by its fp32 scale, cast to ``dt``.
+
+    packed: (..., D//2) uint8, scale: (..., G) fp32 where G divides D.
+    """
+    codes = unpack_int4(packed)                       # (..., D) int32
+    D = codes.shape[-1]
+    G = scale.shape[-1]
+    x = codes.reshape(codes.shape[:-1] + (G, D // G)).astype(jnp.float32)
+    x = x * scale[..., None]
+    return x.reshape(codes.shape).astype(dt)
+
+
+def write_kv_quant_int4(pool, pool_scale, kv, block_table, positions,
+                        valid):
+    """``write_kv`` for the int4 pool: group-quantize the incoming rows
+    (``quantize_kv_int4``) and scatter packed codes AND group scales
+    through the same block/offset indexing.
+
+    pool:        (num_blocks, H, block_size, D//2) uint8 packed codes
+    pool_scale:  (num_blocks, H, block_size, G) fp32 group scales
+    kv/block_table/positions/valid: as ``write_kv``
+
+    The group size is implied by the pool geometry (``g = D // G``), so
+    the write path can never disagree with ``init_pools`` about it.
+    Returns ``(pool, pool_scale)`` updated.
+    """
+    bs = pool.shape[2]
+    nb = block_table.shape[1]
+    D = pool.shape[-1] * 2
+    G = pool_scale.shape[-1]
+    blk_idx = jnp.clip(positions // bs, 0, nb - 1)
+    blk = jnp.take_along_axis(block_table, blk_idx, axis=1)      # (B, S)
+    blk = jnp.where(valid, blk, NULL_BLOCK)
+    off = positions % bs
+    packed, scale = quantize_kv_int4(kv, D // G)
+    vals = jnp.transpose(packed, (0, 2, 1, 3))                   # (B, S, H, D/2)
+    sv = jnp.transpose(scale, (0, 2, 1, 3))                      # (B, S, H, G)
+    return (pool.at[blk, :, off].set(vals),
+            pool_scale.at[blk, :, off].set(sv))
+
+
 def gather_kv(pool, block_table):
     """Reassemble a (B, H, L, D) contiguous view from the pool.
 
@@ -207,8 +307,54 @@ def paged_attention(q, ck, cv, q_positions, dt):
     return masked_softmax_attention(q, ck, cv, vis[:, None], dt)
 
 
+def paged_attention_self_residual(q, ck, cv, q_positions, dt, k_new,
+                                  v_new, scale=None):
+    """``paged_attention`` with the KIVI fp-residual SELF lane: each
+    query row's own key/value — the most recent token it can see — is
+    taken from the in-register fp projections (``k_new``/``v_new``)
+    instead of the quantized pool, folded into the SAME fp32 masked
+    softmax so the lockstep with the kernel lowering holds.
+
+    q, ck, cv, q_positions, dt: as ``paged_attention`` (ck/cv are the
+    DEQUANTIZED gathered view of the int4 pool).
+    k_new, v_new: (B, H, S, D) fp K/V of exactly the query tokens, the
+    same tensors ``write_kv_quant_int4`` just scattered.  Query row
+    ``s`` attends to its own position through these (exact fp score and
+    value) and to every earlier position through the pool.
+
+    Why the self lane only: by the time row ``s`` is a PAST lane of some
+    later query, any fp window must have been re-derived from pool bytes
+    to keep writes granularity-independent — but its own step still has
+    the exact fp vectors in registers for free.  Each token is queried
+    exactly once with them (prefix-cached positions are never
+    re-queried), so the residual is dispatch-shape-invariant: chunked
+    prefill, decode, and speculative verify score identically.
+
+    The softmax denominator INCLUDES the self lane (it is the row's
+    ``s == q_position`` column, overridden before scale+mask); rows
+    whose position lies beyond the gathered view (q_pos >= L, a
+    can't-happen guard) simply get no override.
+    """
+    scale = q.shape[-1] ** -0.5 if scale is None else scale
+    L = ck.shape[2]
+    col = jnp.arange(L)
+    vis = col[None, None, :] <= q_positions[:, :, None]          # (B, S, L)
+    self_m = (col[None, None, :] ==
+              q_positions[:, :, None])[:, None]                  # (B, 1, S, L)
+    s = jnp.einsum("bhsd,bhld->bhsl", q, ck).astype(jnp.float32)
+    s_self = jnp.einsum("bhsd,bhsd->bhs", q, k_new).astype(jnp.float32)
+    s = jnp.where(self_m, s_self[..., None], s)
+    s = jnp.where(vis[:, None], s * scale, jnp.finfo(jnp.float32).min)
+    p = jax.nn.softmax(s, axis=-1)
+    p_self = jnp.sum(jnp.where(self_m, p, 0.0), axis=-1)         # (B, H, S)
+    p_main = jnp.where(self_m, 0.0, p).astype(dt)
+    return (jnp.einsum("bhsl,bhld->bhsd", p_main, cv)
+            + p_self[..., None].astype(dt) * v_new.astype(dt))
+
+
 def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
-           kernel: str = "xla", k_scale=None, v_scale=None):
+           kernel: str = "xla", k_scale=None, v_scale=None,
+           k_new=None, v_new=None):
     """THE paged-attention dispatch seam: one entry point, two lowering
     strategies, identical greedy tokens (tests/test_paged_kernel.py).
 
@@ -223,11 +369,21 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
                  TPU).  Callers resolve "auto" BEFORE tracing via
                  ``resolve_kernel`` — this runs under jit, where the
                  choice must be static.
-    k/v_scale:   (num_blocks, H, block_size) fp32 row scales when the
-                 pools hold int8 codes (--serve-kv-dtype int8); both or
-                 neither.  Dequantization happens INSIDE the consume
+    k/v_scale:   fp32 scales when the pools hold quantized codes; both
+                 or neither.  3-d ``(num_blocks, H, block_size)`` row
+                 scales mean int8 codes (--serve-kv-dtype int8); 4-d
+                 ``(num_blocks, H, block_size, G)`` group scales mean
+                 int4 nibble-packed codes (--serve-kv-dtype int4) —
+                 the scale RANK is the dtype discriminator, so no new
+                 pool leaf key is needed and CoW/TP/partial-copy stay
+                 generic.  Dequantization happens INSIDE the consume
                  path — in-register in the kernel, elementwise on the
                  gathered view here — so no fp pool ever materializes.
+    k/v_new:     (B, H, S, D) fp K/V of the query tokens themselves
+                 (the tensors the int4 write just quantized away) —
+                 enables the fp-residual self lane
+                 (``paged_attention_self_residual``).  int4 pools only;
+                 both or neither.
 
     MIXED-ROW CONTRACT: ``lengths`` is per-row and the causal mask is
     built per row from it (``pos = lengths[:, None] + arange(S)``), so
@@ -242,7 +398,13 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
     and int8 by tests/test_mixed_batch.py.
     """
     if (k_scale is None) != (v_scale is None):
-        raise ValueError("int8 pools need both k_scale and v_scale")
+        raise ValueError("quantized pools need both k_scale and v_scale")
+    if (k_new is None) != (v_new is None):
+        raise ValueError("fp residual needs both k_new and v_new")
+    if k_new is not None and (k_scale is None or k_scale.ndim != 4):
+        raise ValueError(
+            "fp-residual k_new/v_new only apply to int4 (group-scaled) "
+            "pools")
     if kernel == "pallas":
         from mpi_tensorflow_tpu.ops import paged_attention_kernel as pk
 
@@ -251,7 +413,7 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
                  else pk.paged_prefill_attention)
         return fused(q, k_pool, v_pool, block_table, lengths,
                      interpret=interpret, k_scale=k_scale,
-                     v_scale=v_scale)
+                     v_scale=v_scale, k_new=k_new, v_new=v_new)
     if kernel != "xla":
         raise ValueError(
             f"unresolved paged-attention kernel {kernel!r}: callers "
@@ -260,29 +422,39 @@ def attend(q, k_pool, v_pool, block_table, lengths, dt, *,
     pos = lengths[:, None] + jnp.arange(S, dtype=jnp.int32)
     if k_scale is not None:
         # dequantize the gathered blocks elementwise, in lockstep with
-        # the kernel's in-register step (dequantize_kv is the shared
-        # contract), BEFORE the unchanged transpose/reshape + softmax
+        # the kernel's in-register step (dequantize_kv /
+        # dequantize_kv_int4 are the shared contracts), BEFORE the
+        # unchanged transpose/reshape + softmax
         ck = _gather_kv_dequant(k_pool, k_scale, block_table, q.dtype)
         cv = _gather_kv_dequant(v_pool, v_scale, block_table, q.dtype)
     else:
         ck = gather_kv(k_pool, block_table)
         cv = gather_kv(v_pool, block_table)
+    if k_new is not None:
+        return paged_attention_self_residual(q, ck, cv, pos, dt,
+                                             k_new, v_new)
     return paged_attention(q, ck, cv, pos, dt)
 
 
 def _gather_kv_dequant(pool, pool_scale, block_table, dt):
-    """``gather_kv`` over an int8 pool: gather codes and scales through
-    the same table, dequantize, reassemble the (B, H, L, D) view."""
-    g = pool[block_table]                        # (B, NB, H, bs, D) int8
-    gs = pool_scale[block_table]                 # (B, NB, H, bs) f32
-    g = dequantize_kv(g, gs, dt)
+    """``gather_kv`` over a quantized pool: gather codes and scales
+    through the same table, dequantize (int8 row scales or int4 group
+    scales, discriminated by scale rank), reassemble the (B, H, L, D)
+    view."""
+    g = pool[block_table]                        # (B, NB, H, bs, D|D/2)
+    gs = pool_scale[block_table]                 # (B, NB, H, bs[, G])
+    if pool_scale.ndim == 4:
+        g = dequantize_kv_int4(g, gs, dt)        # unpacks D/2 -> D
+    else:
+        g = dequantize_kv(g, gs, dt)
     B, NB, H, bs, D = g.shape
     return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(B, H, NB * bs, D)
 
 
 def resolve_kernel(choice: str, cfg, block_size: int,
                    prefill_chunk: int = 64,
-                   kv_dtype: str = "fp32") -> str:
+                   kv_dtype: str = "fp32",
+                   kv_group: int = 32) -> str:
     """Resolve the ``--serve-kernel`` knob to a static lowering choice.
 
     - "xla"    -> "xla"     (always available, exact)
@@ -309,5 +481,5 @@ def resolve_kernel(choice: str, cfg, block_size: int,
 
     ok = pk.kernel_supported(jnp.dtype(cfg.dtype).name, cfg.heads,
                              cfg.head_dim, block_size, prefill_chunk,
-                             kv_dtype)
+                             kv_dtype, kv_group)
     return "pallas" if ok else "xla"

@@ -67,8 +67,28 @@ def _nlive(length, S: int, bs: int, NB: int):
     return jnp.clip((length + S + bs - 1) // bs, 1, NB)
 
 
+def _dequant_int4_block(codes, scales, dt):
+    """In-register int4 dequant of one fetched pool block — the exact
+    ops/paged_attention.dequantize_kv_int4 contract (unpack split-half
+    nibbles, sign-extend, scale per D-group).
+
+    codes:  (H, bs, D//2) uint8 packed, scales: (H, bs, G) fp32.
+    Returns (H, bs, D) in ``dt``.
+    """
+    c = codes.astype(jnp.int32)
+    lo = c & 0xF
+    hi = (c >> 4) & 0xF
+    full = jnp.concatenate([lo, hi], axis=-1)          # (H, bs, D)
+    full = full - jnp.where(full > 7, 16, 0)
+    H, bs, D = full.shape
+    G = scales.shape[-1]
+    x = full.reshape(H, bs, G, D // G).astype(jnp.float32)
+    x = x * scales[..., None]
+    return x.reshape(H, bs, D).astype(dt)
+
+
 def _paged_kernel(*refs, scale: float, block_size: int,
-                  quantized: bool = False):
+                  mode: str = "fp32", residual: bool = False):
     """One (batch-slot, kv-block) grid step of the online softmax.
 
     q_ref:  (1, H, S, D)   — the row's whole query block (revisited)
@@ -77,17 +97,37 @@ def _paged_kernel(*refs, scale: float, block_size: int,
     o_ref:  (1, H, S, D)   — written once, at the last LIVE block
     scratch: acc (H, S, D) f32, m/l (H, S, STAT_LANES) f32
 
-    ``quantized`` (int8 pools, --serve-kv-dtype int8): k/v_ref hold int8
-    codes and two extra refs ride between them — ks_ref/vs_ref, the
-    ``(1, H, bs)`` fp32 row scales of the SAME pool block (their
-    BlockSpec shares the kv index map, so code block and scale block can
-    never skew).  The codes dequantize IN REGISTER right here —
-    ``(codes.astype(f32) * scale).astype(q.dtype)``, the exact
-    ops/paged_attention.dequantize_kv contract the XLA gather path
-    applies elementwise — before the unchanged fp32 matmul/softmax; no
-    fp pool ever materializes.
+    ``mode`` selects the pool storage format the step consumes:
+
+    - "int8" (--serve-kv-dtype int8): k/v_ref hold int8 codes and two
+      extra refs ride between them — ks_ref/vs_ref, the ``(1, H, bs)``
+      fp32 row scales of the SAME pool block (their BlockSpec shares
+      the kv index map, so code block and scale block can never skew).
+      The codes dequantize IN REGISTER right here — ``(codes.astype(f32)
+      * scale).astype(q.dtype)``, the exact ops/paged_attention.
+      dequantize_kv contract the XLA gather path applies elementwise —
+      before the unchanged fp32 matmul/softmax; no fp pool ever
+      materializes.
+    - "int4" (--serve-kv-dtype int4): k/v_ref hold ``(1, H, bs, D//2)``
+      nibble-packed uint8 codes, ks/vs_ref the ``(1, H, bs, G)`` fp32
+      GROUP scales; ``_dequant_int4_block`` unpacks + dequantizes in
+      register (the dequantize_kv_int4 contract).
+
+    ``residual`` (int4 only) adds the KIVI fp-residual self lane: two
+    more refs kn_ref/vn_ref — ``(1, H, S, D)`` fp K/V of exactly the
+    query tokens (q_map-indexed, revisited each step).  Where a score
+    column IS the query row's own position (``col == qpos``), the int4
+    score is overridden with the exact fp dot product ``q · kn`` BEFORE
+    scale+mask, and that column's probability weights ``vn`` instead of
+    the dequantized pool V — the in-kernel mirror of
+    ops/paged_attention.paged_attention_self_residual, so both
+    lowerings agree within tolerance.  The self column lives in exactly
+    one live grid step; the denominator (l) keeps its weight.
     """
-    if quantized:
+    if mode == "int4" and residual:
+        (bt_ref, len_ref, q_ref, kn_ref, vn_ref, k_ref, ks_ref, v_ref,
+         vs_ref, o_ref, acc, m_scr, l_scr) = refs
+    elif mode in ("int8", "int4"):
         (bt_ref, len_ref, q_ref, k_ref, ks_ref, v_ref, vs_ref, o_ref,
          acc, m_scr, l_scr) = refs
     else:
@@ -111,11 +151,14 @@ def _paged_kernel(*refs, scale: float, block_size: int,
         q = q_ref[0]                                   # (H, S, D)
         k = k_ref[0]                                   # (H, bs, D)
         v = v_ref[0]
-        if quantized:
+        if mode == "int8":
             k = (k.astype(jnp.float32)
                  * ks_ref[0][..., None]).astype(q.dtype)
             v = (v.astype(jnp.float32)
                  * vs_ref[0][..., None]).astype(q.dtype)
+        elif mode == "int4":
+            k = _dequant_int4_block(k, ks_ref[0], q.dtype)
+            v = _dequant_int4_block(v, vs_ref[0], q.dtype)
         s = lax.dot_general(
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)        # (H, S, bs)
@@ -123,6 +166,14 @@ def _paged_kernel(*refs, scale: float, block_size: int,
         # path's mask (q positions are lengths[b] + [0, S))
         col = j * bs + lax.broadcasted_iota(jnp.int32, (S, bs), 1)
         qpos = len_ref[b] + lax.broadcasted_iota(jnp.int32, (S, bs), 0)
+        if residual:
+            # fp self lane: exact q·k_new score for each row's own
+            # column, overriding the int4 score BEFORE scale+mask
+            self_m = col == qpos                       # (S, bs)
+            kn = kn_ref[0]                             # (H, S, D)
+            s_self = jnp.sum(q.astype(jnp.float32)
+                             * kn.astype(jnp.float32), axis=-1)  # (H, S)
+            s = jnp.where(self_m[None], s_self[:, :, None], s)
         s = jnp.where((col <= qpos)[None], s * scale,
                       jnp.finfo(jnp.float32).min)
         m_prev = m_scr[:, :, 0:1]                      # (H, S, 1)
@@ -131,9 +182,21 @@ def _paged_kernel(*refs, scale: float, block_size: int,
         p = jnp.exp(s - m_new)                         # (H, S, bs)
         corr = jnp.exp(m_prev - m_new)                 # (H, S, 1)
         l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc[:] = acc[:] * corr + lax.dot_general(
-            p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)        # (H, S, D)
+        if residual:
+            # the self column's weight multiplies the fp v_new row, not
+            # the dequantized pool row; l keeps the full p sum
+            p_main = jnp.where(self_m[None], 0.0, p)
+            p_self = jnp.sum(jnp.where(self_m[None], p, 0.0),
+                             axis=-1)                  # (H, S)
+            vn = vn_ref[0]                             # (H, S, D)
+            acc[:] = acc[:] * corr + lax.dot_general(
+                p_main.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32) \
+                + p_self[..., None] * vn.astype(jnp.float32)
+        else:
+            acc[:] = acc[:] * corr + lax.dot_general(
+                p.astype(v.dtype), v, (((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=jnp.float32)    # (H, S, D)
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
@@ -146,11 +209,18 @@ def _paged_kernel(*refs, scale: float, block_size: int,
 
 def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
                 scale: float, interpret: bool,
-                k_scale=None, v_scale=None):
+                k_scale=None, v_scale=None, k_new=None, v_new=None):
     B, H, S, D = q.shape
     NB = block_table.shape[1]
     bs = k_pool.shape[2]
-    quantized = k_scale is not None
+    if k_scale is None:
+        mode = "fp32"
+    elif k_scale.ndim == 4:
+        mode = "int4"                    # group scales (.., bs, G)
+    else:
+        mode = "int8"                    # row scales (.., bs)
+    residual = k_new is not None
+    Dk = k_pool.shape[-1]                # D (fp/int8) or D//2 (int4)
 
     def kv_map(b, j, bt, lens):
         # clamp dead steps to the last live block: the repeated index
@@ -164,10 +234,15 @@ def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
         jl = jnp.minimum(j, _nlive(lens[b], S, bs, NB) - 1)
         return (bt[b, jl], 0, 0)
 
+    def gs_map(b, j, bt, lens):
+        # int4 group-scale sibling: same clamped block id, 4-D block
+        jl = jnp.minimum(j, _nlive(lens[b], S, bs, NB) - 1)
+        return (bt[b, jl], 0, 0, 0)
+
     def q_map(b, j, bt, lens):
         return (b, 0, 0, 0)
 
-    if quantized:
+    if mode == "int8":
         # scales ride as regular streamed inputs indexed by the SAME
         # (clamped) block id as their code block — each grid step DMAs
         # the (1, H, bs) scale rows next to the (1, H, bs, D) codes
@@ -179,6 +254,24 @@ def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
             pl.BlockSpec((1, H, bs), ks_map),
         ]
         operands = (q, k_pool, k_scale, v_pool, v_scale)
+    elif mode == "int4":
+        G = k_scale.shape[-1]
+        in_specs = [pl.BlockSpec((1, H, S, D), q_map)]
+        operands = [q]
+        if residual:
+            # fp residual K/V of the query tokens: q_map-indexed, so
+            # every grid step revisits the row's own (1, H, S, D) block
+            in_specs += [pl.BlockSpec((1, H, S, D), q_map),
+                         pl.BlockSpec((1, H, S, D), q_map)]
+            operands += [k_new, v_new]
+        in_specs += [
+            pl.BlockSpec((1, H, bs, Dk), kv_map),
+            pl.BlockSpec((1, H, bs, G), gs_map),
+            pl.BlockSpec((1, H, bs, Dk), kv_map),
+            pl.BlockSpec((1, H, bs, G), gs_map),
+        ]
+        operands += [k_pool, k_scale, v_pool, v_scale]
+        operands = tuple(operands)
     else:
         in_specs = [
             pl.BlockSpec((1, H, S, D), q_map),
@@ -200,7 +293,7 @@ def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
     )
     return pl.pallas_call(
         functools.partial(_paged_kernel, scale=scale, block_size=bs,
-                          quantized=quantized),
+                          mode=mode, residual=residual),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         interpret=interpret,
@@ -210,7 +303,8 @@ def _paged_call(q, k_pool, v_pool, block_table, lengths, *,
 
 def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
                            scale=None, interpret: bool = False,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None,
+                           k_new=None, v_new=None):
     """Fused paged attention over pool blocks — no gathered view.
 
     q:           (B, H, S, D) queries; S=1 decode, S=chunk prefill
@@ -223,10 +317,15 @@ def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
                  queries occupy absolute positions
                  [lengths[b], lengths[b] + S) and their K/V must already
                  be scattered into the pool (write_kv runs first)
-    k/v_scale:   (num_blocks, H, block_size) fp32 row scales when the
-                 pools hold int8 codes (both or neither); the kernel
-                 streams them beside the code blocks and dequantizes in
-                 register (see _paged_kernel)
+    k/v_scale:   fp32 scales when the pools hold quantized codes (both
+                 or neither): 3-d ``(num_blocks, H, block_size)`` row
+                 scales = int8 codes; 4-d ``(num_blocks, H, block_size,
+                 G)`` group scales = int4 nibble-packed codes (the
+                 scale RANK discriminates, mirroring attend).  The
+                 kernel streams them beside the code blocks and
+                 dequantizes in register (see _paged_kernel)
+    k/v_new:     (B, H, S, D) fp K/V of the query tokens (int4 only,
+                 both or neither) — enables the fp-residual self lane
 
     Returns (B, H, S, D) in q.dtype.  Numerically this is the online-
     softmax evaluation of ops/paged_attention.paged_attention over the
@@ -244,16 +343,24 @@ def paged_attention_kernel(q, k_pool, v_pool, block_table, lengths, *,
     mixed batches in fp32 and int8.
     """
     if (k_scale is None) != (v_scale is None):
-        raise ValueError("int8 pools need both k_scale and v_scale")
+        raise ValueError("quantized pools need both k_scale and v_scale")
+    if (k_new is None) != (v_new is None):
+        raise ValueError("fp residual needs both k_new and v_new")
+    if k_new is not None and (k_scale is None or k_scale.ndim != 4):
+        raise ValueError(
+            "fp-residual k_new/v_new only apply to int4 (group-scaled) "
+            "pools")
     scale = q.shape[-1] ** -0.5 if scale is None else scale
     return _paged_call(q, k_pool, v_pool, block_table, lengths,
                        scale=scale, interpret=interpret,
-                       k_scale=k_scale, v_scale=v_scale)
+                       k_scale=k_scale, v_scale=v_scale,
+                       k_new=k_new, v_new=v_new)
 
 
 def paged_decode_attention(q, k_pool, v_pool, block_table, lengths, *,
                            scale=None, interpret: bool = False,
-                           k_scale=None, v_scale=None):
+                           k_scale=None, v_scale=None,
+                           k_new=None, v_new=None):
     """Single-token decode specialization (S must be 1) — the serving
     hot path.  Thin wrapper so call sites (and probes) name the phase
     they are on; the grid/kernel body is shared with chunked prefill."""
@@ -263,26 +370,30 @@ def paged_decode_attention(q, k_pool, v_pool, block_table, lengths, *,
     return paged_attention_kernel(q, k_pool, v_pool, block_table,
                                   lengths, scale=scale,
                                   interpret=interpret,
-                                  k_scale=k_scale, v_scale=v_scale)
+                                  k_scale=k_scale, v_scale=v_scale,
+                                  k_new=k_new, v_new=v_new)
 
 
 def paged_prefill_attention(q, k_pool, v_pool, block_table, lengths, *,
                             scale=None, interpret: bool = False,
-                            k_scale=None, v_scale=None):
+                            k_scale=None, v_scale=None,
+                            k_new=None, v_new=None):
     """Chunked-prefill variant: S = chunk queries per row at positions
     [lengths[b], lengths[b] + S), causal within the chunk and over the
     cache via the same visibility test (col <= q position)."""
     return paged_attention_kernel(q, k_pool, v_pool, block_table,
                                   lengths, scale=scale,
                                   interpret=interpret,
-                                  k_scale=k_scale, v_scale=v_scale)
+                                  k_scale=k_scale, v_scale=v_scale,
+                                  k_new=k_new, v_new=v_new)
 
 
 @functools.lru_cache(maxsize=16)
 def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
                      head_dim: int = 64, block_size: int = 16,
                      prefill_chunk: int = 64,
-                     kv_dtype: str = "fp32") -> bool:
+                     kv_dtype: str = "fp32",
+                     kv_group: int = 32) -> bool:
     """One-time probe per geometry: do the decode AND prefill kernels
     compile for this backend's Mosaic?  The serving dispatcher gates
     ``--serve-kernel auto`` on this (passing the dtype/heads/head_dim/
@@ -311,13 +422,23 @@ def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
             return False
         dt = jnp.dtype(dtype_name)
         B, NB, bs = 8, 4, block_size
-        # int8 mode swaps the pool storage for codes + scale siblings;
-        # Mosaic's int8 tiling rules differ from fp, so the probe must
-        # compile the exact variant the engine will dispatch
-        pool_dt = jnp.int8 if kv_dtype == "int8" else dt
-        pool = jnp.zeros((1 + B * NB, heads, bs, head_dim), pool_dt)
-        scales = (jnp.zeros((1 + B * NB, heads, bs), jnp.float32)
-                  if kv_dtype == "int8" else None)
+        # quantized modes swap the pool storage for codes + scale
+        # siblings; Mosaic's sub-fp tiling rules differ from fp, so the
+        # probe must compile the exact variant the engine will dispatch
+        # — for int4 that is nibble-packed uint8 codes + 4-d group
+        # scales + the fp-residual k_new/v_new operands
+        if kv_dtype == "int4":
+            g = min(kv_group, head_dim)
+            pool = jnp.zeros((1 + B * NB, heads, bs, head_dim // 2),
+                             jnp.uint8)
+            scales = jnp.zeros((1 + B * NB, heads, bs, head_dim // g),
+                               jnp.float32)
+        elif kv_dtype == "int8":
+            pool = jnp.zeros((1 + B * NB, heads, bs, head_dim), jnp.int8)
+            scales = jnp.zeros((1 + B * NB, heads, bs), jnp.float32)
+        else:
+            pool = jnp.zeros((1 + B * NB, heads, bs, head_dim), dt)
+            scales = None
         bt = jnp.arange(1, 1 + B * NB, dtype=jnp.int32).reshape(B, NB)
         lens = jnp.full((B,), bs, jnp.int32)
         chunks = []                       # 1 (decode) + pow2 buckets
@@ -327,10 +448,13 @@ def kernel_supported(dtype_name: str = "bfloat16", heads: int = 12,
             S *= 2
         for S in chunks:
             q = jnp.zeros((B, heads, S, head_dim), dt)
+            kn = (jnp.zeros((B, heads, S, head_dim), dt)
+                  if kv_dtype == "int4" else None)
             # graft-lint: jit-ok(compile probe: runs once at kernel resolve, not per step)
             jax.jit(functools.partial(
                 paged_attention_kernel,
-                k_scale=scales, v_scale=scales)).lower(
+                k_scale=scales, v_scale=scales,
+                k_new=kn, v_new=kn)).lower(
                 q, pool, pool, bt, lens).compile()
         return True
     except Exception as e:   # noqa: BLE001 — any compile failure disables

@@ -169,7 +169,34 @@ class ServeConfig:
                                   # in register; XLA: on the gathered
                                   # view), and greedy outputs track the
                                   # fp32 pool at a token-match-rate
-                                  # gate rather than token identity
+                                  # gate rather than token identity;
+                                  # "int4" nibble-packs two codes per
+                                  # byte with per-group fp32 scales
+                                  # (kv_group) plus a KIVI fp-residual
+                                  # self lane — the next capacity rung
+                                  # (~6-8x the tokens per pool byte)
+    kv_group: int = 32            # int4 scale-group size along head_dim
+                                  # (--serve-kv-group): one fp32 scale
+                                  # per ``min(kv_group, head_dim)``
+                                  # channels (clamped so the default
+                                  # stays valid on tiny heads; must
+                                  # divide head_dim).  Smaller groups =
+                                  # tighter quantization, more scale
+                                  # bytes.  Consumed only under
+                                  # kv_dtype=int4
+    kv_tier: str = "off"          # host-RAM block tier (--serve-kv-
+                                  # tier): "host" demotes cold prefix-
+                                  # cache blocks to a HostBlockStore on
+                                  # eviction instead of discarding
+                                  # them, and promotes them back into
+                                  # fresh device blocks when a later
+                                  # prompt walks the same trie path —
+                                  # multi-turn sessions stop re-paying
+                                  # prefill after their prefix ages out
+                                  # of the device pool.  Requires
+                                  # prefix_cache on (the trie's token
+                                  # paths are the tier's keys); "off"
+                                  # is byte-for-byte untiered
     tp: int = 1                   # tensor-parallel shards (--serve-tp):
                                   # >1 partitions the head-major pool,
                                   # QKV/O projections, and MLP over a
@@ -233,6 +260,8 @@ class ServeConfig:
                     mixed_batch=config.serve_mixed_batch,
                     prefill_budget=config.serve_prefill_budget,
                     kv_dtype=config.serve_kv_dtype,
+                    kv_group=config.serve_kv_group,
+                    kv_tier=config.serve_kv_tier,
                     tp=config.serve_tp,
                     deadline_ms=config.serve_deadline_ms,
                     queue_depth=config.serve_queue_depth,
@@ -307,9 +336,21 @@ class ServeConfig:
                 "serve mixed_batch and speculative each replace the "
                 "decode dispatch with their own fused forward; they do "
                 "not compose — pick one")
-        if self.kv_dtype not in ("fp32", "int8"):
+        if self.kv_dtype not in ("fp32", "int8", "int4"):
             raise ValueError(
-                f"serve kv dtype must be fp32|int8, got {self.kv_dtype!r}")
+                f"serve kv dtype must be fp32|int8|int4, "
+                f"got {self.kv_dtype!r}")
+        if self.kv_group < 1:
+            raise ValueError(
+                f"serve kv_group must be >= 1, got {self.kv_group}")
+        if self.kv_tier not in ("off", "host"):
+            raise ValueError(
+                f"serve kv_tier must be off|host, got {self.kv_tier!r}")
+        if self.kv_tier == "host" and self.prefix_cache == "off":
+            raise ValueError(
+                "serve kv_tier demotes/promotes radix-trie blocks; with "
+                "prefix_cache off there are no trie paths to key the "
+                "host store by — turn the cache on or drop the tier")
         if self.tp < 1:
             raise ValueError(f"serve tp must be >= 1, got {self.tp}")
         if (self.deadline_ms is not None and self.deadline_ms <= 0) \
@@ -394,7 +435,7 @@ class PagedDecodeEngine:
             model.cfg, heads=model.cfg.heads // serve.tp))
         self.kernel = paged_ops.resolve_kernel(
             serve.kernel, kcfg, serve.block_size,
-            serve.prefill_chunk, serve.kv_dtype)
+            serve.prefill_chunk, serve.kv_dtype, serve.kv_group)
         if self.tp_mesh is not None:
             self.params = tp_lib.shard_params(model, params, self.tp_mesh)
             self._paged_forward = tp_lib.make_paged_forward(
@@ -424,6 +465,15 @@ class PagedDecodeEngine:
         # so every (src, dst, n) reuses the one compiled program
         self._partial_fn = jax.jit(
             self._partial_impl,
+            donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
+        # host-tier promotion (--serve-kv-tier host): write a demoted
+        # block's host bytes into a freshly allocated device block —
+        # same discipline as _cow_fn/_partial_fn: the destination id
+        # rides as a traced scalar and the host leaves have one fixed
+        # shape (a single block row per pool leaf), so every promotion
+        # reuses the one compiled program
+        self._promote_fn = jax.jit(
+            self._promote_impl,
             donate_argnums=(0,) if jax.default_backend() == "tpu" else ())
         # speculative decoding: the verify step runs pending + k draft
         # tokens through one forward (chunked-prefill math, decode-style
@@ -460,6 +510,14 @@ class PagedDecodeEngine:
                 # row null-block self-copy is a no-op write that pays
                 # its one compile before any timed window opens
                 self.pools = self._partial_fn(self.pools, z, z, z)
+            if self.serve.kv_tier == "host":
+                # same contract for the promote dispatch: a zero-leaf
+                # write into the null block pays its one compile, so a
+                # first promotion inside a timed steady-state window
+                # can never register as a recompile
+                host0 = [{key: jnp.zeros(leaf.shape[1:], leaf.dtype)
+                          for key, leaf in p.items()} for p in self.pools]
+                self.pools = self._promote_fn(self.pools, host0, z)
         if self.drafter is not None:
             # pre-warm the verify dispatch at EVERY (slot bucket, table
             # bucket) x width-(k+1) shape, plus the drafter's own chunk
@@ -488,7 +546,7 @@ class PagedDecodeEngine:
 
         self.pools = paged_cache.init_pools(
             self.model.cfg, self.serve.num_blocks, self.serve.block_size,
-            self.serve.kv_dtype)
+            self.serve.kv_dtype, self.serve.kv_group)
         if self.tp_mesh is not None:
             # head-axis sharding (serving/tp): one block id addresses
             # the same slot of every shard's local-heads pool, so the
@@ -503,6 +561,15 @@ class PagedDecodeEngine:
         self.prefix_cache = (
             prefix_lib.PrefixCache(self.allocator, self.serve.block_size)
             if self.serve.prefix_cache == "on" else None)
+        # host-RAM block tier (--serve-kv-tier host): resets WITH the
+        # pools/trie — stored bytes index device content that just went
+        # away, and crash recovery rebuilds both from the journal
+        self.tier = (paged_cache.HostBlockStore()
+                     if self.serve.kv_tier == "host" else None)
+        if self.tier is not None and self.prefix_cache is not None:
+            self.prefix_cache.tier = self.tier
+            self.prefix_cache.demote_fetch = self._demote_fetch
+            self.prefix_cache.promote_put = self._promote_put
         if self.drafter is not None:
             # the draft pool indexes device state that resets with the
             # engine's own pools (crash recovery rebuilds both)
@@ -609,6 +676,37 @@ class PagedDecodeEngine:
         half of partial tail-block sharing.  All three operands are
         traced scalars — one compile, like ``_cow_impl``."""
         return paged_cache.partial_copy_block(pools, src, dst, n)
+
+    def _promote_impl(self, pools, host, dst):
+        """Write one block row of host leaves into pool block ``dst``
+        (all layers, every leaf — codes and, under quantized pools,
+        their scale siblings): the device half of tier promotion.
+        ``dst`` is a traced scalar; ``host`` is a per-layer list of
+        single-block leaves with one fixed shape — one compile."""
+        return [{key: leaf.at[dst].set(hb[key])
+                 for key, leaf in p.items()}
+                for p, hb in zip(pools, host)]
+
+    def _demote_fetch(self, block: int) -> list:
+        """Copy pool block ``block`` to host (per-layer dicts of
+        np.ndarray rows) — the prefix cache calls this just before
+        eviction releases the device block (--serve-kv-tier host)."""
+        return [{key: np.asarray(leaf[block])  # graft-lint: sync-ok(cold-block demotion off the dispatch path)
+                 for key, leaf in p.items()} for p in self.pools]
+
+    def _promote_put(self, leaves: list, block: int) -> None:
+        """Land demoted host bytes in freshly allocated device block
+        ``block`` via the pre-warmed one-compile promote dispatch —
+        called during the admission match walk, BEFORE the sequence's
+        first dispatch, so the promoted content is in place when the
+        block table first references it."""
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        host = [{k: jnp.asarray(v) for k, v in p.items()} for p in leaves]
+        self.pools = self._promote_fn(self.pools, host,
+                                      jnp.asarray(block, jnp.int32))
+        self.tier.promote_ms_total += (time.perf_counter() - t0) * 1e3
 
     def _verify_impl(self, params, pools, tokens, lengths, n_valid,
                      tables):
@@ -1386,6 +1484,7 @@ class PagedDecodeEngine:
             "kernel": self.kernel,
             "prefix": self.prefix_block(),
             "speculation": self.speculation_block(),
+            "tier": self.tier_block(),
             "peak_blocks_in_use": self.peak_blocks_in_use,
             "peak_live_blocks": self.peak_live_blocks,
             "tokens": total,
@@ -1473,6 +1572,23 @@ class PagedDecodeEngine:
             mode=self.serve.speculative, draft_k=self.serve.draft_k,
             draft_auto=self.serve.draft_auto)
 
+    def tier_block(self) -> dict:
+        """Canonical host-tier accounting block
+        (utils/metrics_writer.tier_block — the ONE constructor engine
+        results and bench JSON share); zero-safe with tiering off."""
+        from mpi_tensorflow_tpu.utils.metrics_writer import tier_block
+
+        if self.tier is None:
+            return tier_block()
+        s = self.tier.stats()
+        return tier_block(
+            enabled=True, mode=self.serve.kv_tier,
+            demotions=s["demotions"], promotions=s["promotions"],
+            host_blocks=s["host_blocks"],
+            host_blocks_peak=s["host_blocks_peak"],
+            promote_ms_total=s["promote_ms_total"],
+            block_size=self.serve.block_size)
+
     def compile_counts(self) -> dict:
         """Live jit-cache entry counts — THE zero-recompile probe: a
         steady-state serving window must not grow either number.  A
@@ -1490,7 +1606,8 @@ class PagedDecodeEngine:
                "cow": size(self._cow_fn),
                "partial": size(self._partial_fn),
                "verify": size(self._verify_fn),
-               "mixed": size(self._mixed_fn)}
+               "mixed": size(self._mixed_fn),
+               "promote": size(self._promote_fn)}
         if self.drafter is not None:
             # a drafter's own jitted dispatches are inside the steady-
             # state loop too — the contract covers them like the

@@ -151,7 +151,7 @@ def partial_copy_block(pools: list, src, dst, n) -> list:
 
 
 def init_pools(cfg, num_blocks: int, block_size: int,
-               kv_dtype: str = "fp32") -> list:
+               kv_dtype: str = "fp32", kv_group: int = 32) -> list:
     """Per-layer K/V block pools (zeros), mirroring the per-layer
     ``{"k", "v"}`` pytree shape of models/gpt.init_cache so the engine
     threads them through jit the same way.
@@ -167,18 +167,118 @@ def init_pools(cfg, num_blocks: int, block_size: int,
       arrays share the pool's first two axes, so block-table indexing,
       copy-on-write, and TP head-sharding treat them exactly like the
       code arrays.
+    - "int4": blocks hold nibble-packed uint8 codes of shape
+      ``(num_blocks, heads, block_size, head_dim // 2)`` — two codes
+      per byte (ops/paged_attention.pack_int4) — and the scale siblings
+      grow a trailing group axis: ``(num_blocks, heads, block_size,
+      head_dim // g)`` fp32 with ``g = min(kv_group, head_dim)`` (the
+      --serve-kv-group knob, clamped so the default 32 stays valid on
+      tiny heads; ``g`` must divide head_dim).  The 4-d scale rank is
+      what the consume paths discriminate int4 on — no new leaf keys,
+      so CoW/partial-copy/TP/journal stay dtype-agnostic.
     """
     import jax.numpy as jnp
 
-    if kv_dtype not in ("fp32", "int8"):
+    if kv_dtype not in ("fp32", "int8", "int4"):
         raise ValueError(
-            f"serve kv dtype must be fp32|int8, got {kv_dtype!r}")
+            f"serve kv dtype must be fp32|int8|int4, got {kv_dtype!r}")
     if kv_dtype == "int8":
         z = jnp.zeros((num_blocks, cfg.heads, block_size, cfg.head_dim),
                       jnp.int8)
         s = jnp.zeros((num_blocks, cfg.heads, block_size), jnp.float32)
         return [{"k": z, "v": z, "k_scale": s, "v_scale": s}
                 for _ in range(cfg.layers)]
+    if kv_dtype == "int4":
+        g = min(kv_group, cfg.head_dim)
+        if cfg.head_dim % 2 or g < 1 or cfg.head_dim % g:
+            raise ValueError(
+                f"int4 pool needs even head_dim divisible by the "
+                f"effective group min(kv_group, head_dim); got "
+                f"head_dim={cfg.head_dim}, kv_group={kv_group}")
+        z = jnp.zeros(
+            (num_blocks, cfg.heads, block_size, cfg.head_dim // 2),
+            jnp.uint8)
+        s = jnp.zeros(
+            (num_blocks, cfg.heads, block_size, cfg.head_dim // g),
+            jnp.float32)
+        return [{"k": z, "v": z, "k_scale": s, "v_scale": s}
+                for _ in range(cfg.layers)]
     z = jnp.zeros((num_blocks, cfg.heads, block_size, cfg.head_dim),
                   cfg.dtype)
     return [{"k": z, "v": z} for _ in range(cfg.layers)]
+
+
+class HostBlockStore:
+    """Host-RAM tier for demoted KV blocks (--serve-kv-tier host).
+
+    When the prefix cache evicts an unreferenced trie leaf under pool
+    pressure, the block's bytes are copied to host memory here instead
+    of being lost; a later prompt that walks the same trie path
+    PROMOTES the bytes back into a freshly allocated device block
+    before its first dispatch (no recompute, no re-prefill).  KVQuant
+    (arXiv:2401.18079) frames the cache as the long-context bottleneck;
+    tiering is the rung that stops multi-turn sessions from re-paying
+    prefill after their prefix ages out of the device pool.
+
+    Keys are full trie TOKEN PATHS (tuple of per-block token tuples,
+    root -> leaf), so an entry can only ever be re-admitted for the
+    exact token stream that produced it — and because quantization is
+    write-granularity independent, the stored bytes equal what a fresh
+    prefill of that stream would write (the demote->promote byte-
+    identity the tiering tests pin).  Values are per-layer dicts of
+    host ``np.ndarray`` leaves, one row of each pool leaf (the block's
+    codes + scales), dtype-agnostic.
+
+    Pure host Python with no jax import (the allocator discipline):
+    insertion-ordered dict, FIFO drop-oldest beyond ``capacity``
+    (None = unbounded — host RAM is the budget), counters for the
+    metrics ``tier`` block.
+    """
+
+    def __init__(self, capacity: int | None = None):
+        if capacity is not None and capacity < 1:
+            raise ValueError(
+                f"host tier capacity must be >= 1 blocks, got {capacity}")
+        self.capacity = capacity
+        self._store: Dict[tuple, list] = {}
+        self.demotions = 0
+        self.promotions = 0
+        self.dropped = 0
+        self.host_blocks_peak = 0
+        self.promote_ms_total = 0.0
+
+    def __len__(self) -> int:
+        return len(self._store)
+
+    def __contains__(self, key: tuple) -> bool:
+        return key in self._store
+
+    def put(self, key: tuple, leaves: list) -> None:
+        """Admit a demoted block's host leaves under its trie path key.
+        Re-demotion of the same path overwrites (byte-identical by the
+        determinism contract, so this is a no-op in content)."""
+        self._store.pop(key, None)
+        self._store[key] = leaves
+        self.demotions += 1
+        if self.capacity is not None and len(self._store) > self.capacity:
+            self._store.pop(next(iter(self._store)))
+            self.dropped += 1
+        self.host_blocks_peak = max(self.host_blocks_peak,
+                                    len(self._store))
+
+    def pop(self, key: tuple):
+        """Take a block's leaves out for promotion (or None on miss).
+        The entry leaves the store — after promotion the trie node
+        again owns the canonical copy, on device."""
+        leaves = self._store.pop(key, None)
+        if leaves is not None:
+            self.promotions += 1
+        return leaves
+
+    def stats(self) -> dict:
+        return {"host_blocks": len(self._store),
+                "host_blocks_peak": self.host_blocks_peak,
+                "demotions": self.demotions,
+                "promotions": self.promotions,
+                "dropped": self.dropped,
+                "promote_ms_total": self.promote_ms_total}

@@ -97,6 +97,21 @@ class PrefixCache:
         # replica router's prefix-aware placement feeds its owner map
         # from this digest; None (the default) costs nothing.
         self.root_hook = None
+        # Host-RAM block tier (--serve-kv-tier host): the engine wires
+        # all three or none.  ``tier`` is a paged_cache.HostBlockStore;
+        # ``demote_fetch(block) -> host leaves`` copies a pool block's
+        # bytes to host (called just before eviction releases it);
+        # ``promote_put(leaves, block)`` writes stored bytes into a
+        # freshly allocated device block (called during match walks,
+        # BEFORE the sequence's first dispatch).  Keys are full trie
+        # token paths, so a promoted block is byte-identical to what
+        # re-prefilling its positions would write — tier entries can
+        # never go stale (same path => same bytes, the determinism
+        # contract).  None (the default) keeps eviction pure-free.
+        self.tier = None
+        self.demote_fetch = None
+        self.promote_put = None
+        self.promoted = 0            # nodes re-admitted from the tier
 
     def _tick(self) -> int:
         self._clock += 1
@@ -119,20 +134,58 @@ class PrefixCache:
         shared block — the engine's copy-on-write path detects the
         shared write and gives the sequence a private copy.
         """
-        node, ids = self._root, []
+        node, ids, path = self._root, [], []
         bs = self.block_size
         for j in range(len(prompt) // bs):
-            child = node.children.get(tuple(prompt[j * bs:(j + 1) * bs]))
+            key = tuple(prompt[j * bs:(j + 1) * bs])
+            child = node.children.get(key)
             if child is None:
                 break
             child.last_used = self._tick()
             ids.append(child.block)
+            path.append(key)
             node = child
         self.allocator.share(ids)
+        if self.tier is not None and self.promote_put is not None:
+            node = self._promote_walk(node, prompt, ids, path)
         cached = len(ids) * bs
         if cached >= len(prompt):
             cached = len(prompt) - 1
         return ids, cached
+
+    def _promote_walk(self, node: "_Node", prompt: List[int],
+                      ids: List[int], path: List[Tuple[int, ...]]):
+        """Extend a trie walk through the host tier: where the device
+        trie ran out, demoted blocks whose token path continues the
+        prompt are promoted back — a fresh device block is allocated,
+        the host bytes land in it (``promote_put``, before the
+        sequence's first dispatch), and a trie node is rebuilt in place.
+        The re-admitted node takes the trie's own reference (the alloc)
+        PLUS the sequence's share, exactly the accounting a normal hit
+        leaves, so ``check``/quiescent invariants hold unchanged.
+        ``ids``/``path`` are extended in place; promotion stops at the
+        first tier miss, allocation failure, or prompt end."""
+        bs = self.block_size
+        for j in range(len(ids), len(prompt) // bs):
+            key = tuple(prompt[j * bs:(j + 1) * bs])
+            full = tuple(path) + (key,)
+            # peek before pop: on allocation failure the entry must
+            # survive for a later, less-pressured walk
+            if full not in self.tier or not self.allocator.can_alloc(1):
+                break
+            bid = self.allocator.alloc(1)[0]        # the trie's own ref
+            self.promote_put(self.tier.pop(full), bid)
+            child = _Node(key, bid, node, self._tick())
+            node.children[key] = child
+            self.num_blocks += 1
+            self.promoted += 1
+            if node is self._root and self.root_hook is not None:
+                self.root_hook(key, True)
+            self.allocator.share([bid])             # the sequence's ref
+            ids.append(bid)
+            path.append(key)
+            node = child
+        return node
 
     def match_partial(self, prompt: List[int],
                       matched_blocks: int) -> Optional[Tuple[int, int]]:
@@ -202,6 +255,16 @@ class PrefixCache:
 
     # ---------------- eviction ----------------
 
+    def _path_key(self, node: "_Node") -> tuple:
+        """Full trie token path of ``node`` (root -> node, one token
+        tuple per block) — the host-tier key: token-exact, so a tier
+        entry can only re-admit for the one prefix that produced it."""
+        keys = []
+        while node is not self._root:
+            keys.append(node.key)
+            node = node.parent
+        return tuple(reversed(keys))
+
     def _leaves(self) -> List[_Node]:
         out, stack = [], list(self._root.children.values())
         while stack:
@@ -229,6 +292,14 @@ class PrefixCache:
             victim = min(victims, key=lambda n: n.last_used)
             assert not victim.children
             del victim.parent.children[victim.key]
+            if self.tier is not None and self.demote_fetch is not None:
+                # demote instead of discard: copy the block's bytes to
+                # the host store under its full token path BEFORE the
+                # release recycles the device block.  Children demote
+                # before parents (leaves-only eviction), and promotion
+                # walks parent-first, so chains round-trip intact.
+                self.tier.put(self._path_key(victim),
+                              self.demote_fetch(victim.block))
             self.allocator.release([victim.block])
             self.num_blocks -= 1
             self.evicted += 1
@@ -254,4 +325,4 @@ class PrefixCache:
 
     def stats(self) -> dict:
         return {"blocks": self.num_blocks, "inserted": self.inserted,
-                "evicted": self.evicted}
+                "evicted": self.evicted, "promoted": self.promoted}

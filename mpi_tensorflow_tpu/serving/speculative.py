@@ -164,7 +164,8 @@ class DraftModelDrafter(Drafter):
 
     def __init__(self, model, params, *, num_blocks: int, block_size: int,
                  max_blocks_per_seq: int, chunk: int = 16,
-                 kernel: str = "xla"):
+                 kernel: str = "xla", kv_dtype: str = "fp32",
+                 kv_group: int = 32):
         import jax
 
         if chunk < 1:
@@ -176,6 +177,14 @@ class DraftModelDrafter(Drafter):
         self.max_blocks_per_seq = max_blocks_per_seq
         self.chunk = chunk
         self.kernel = kernel
+        # the draft pool inherits the fleet kv_dtype (the PR 12 marked
+        # extension): with the target pool quantized, an fp32 shadow
+        # pool would dominate the drafter's HBM footprint.  Draft
+        # tokens are verified by the target model before emission, so
+        # draft-side quantization can only change WHICH tokens get
+        # drafted, never correctness
+        self.kv_dtype = kv_dtype
+        self.kv_group = kv_group
         donate = (1,) if jax.default_backend() == "tpu" else ()
         self._feed_fn = jax.jit(self._feed_impl, donate_argnums=donate)
         self._clock = 0
@@ -183,7 +192,8 @@ class DraftModelDrafter(Drafter):
 
     def reset(self) -> None:
         self.pools = init_pools(self.model.cfg, self.num_blocks,
-                                self.block_size)
+                                self.block_size, self.kv_dtype,
+                                self.kv_group)
         self.allocator = BlockAllocator(self.num_blocks)
         self._state: Dict[int, _DraftState] = {}
 
@@ -359,4 +369,6 @@ def make_drafter(mode: str, serve, target_model, *, draft_model=None,
         chunk=min(16, serve.prefill_chunk),
         kernel=paged_ops.resolve_kernel(
             serve.kernel, draft_model.cfg, serve.block_size,
-            min(16, serve.prefill_chunk)))
+            min(16, serve.prefill_chunk), serve.kv_dtype,
+            serve.kv_group),
+        kv_dtype=serve.kv_dtype, kv_group=serve.kv_group)

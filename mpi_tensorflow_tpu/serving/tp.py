@@ -96,11 +96,13 @@ def param_specs(model, mesh: Mesh):
 
 def pool_specs(layers: int, kv_dtype: str = "fp32"):
     """PartitionSpec pytree for the per-layer K/V pools: the head axis
-    (axis 1 of ``(num_blocks, H, block_size, D)``) over ``tp``.  An
-    int8 pool's scale siblings — ``(num_blocks, H, block_size)`` —
-    carry heads on the SAME axis 1, so one spec serves both leaves."""
+    (axis 1 of ``(num_blocks, H, block_size, D)``) over ``tp``.  A
+    quantized pool's scale siblings — ``(num_blocks, H, block_size)``
+    int8 row scales or ``(num_blocks, H, block_size, G)`` int4 group
+    scales — carry heads on the SAME axis 1, so one spec serves every
+    leaf (int4's packed-code D//2 axis is unsharded, like D)."""
     s = P(None, TP_AXIS)
-    if kv_dtype == "int8":
+    if kv_dtype in ("int8", "int4"):
         return [{"k": s, "v": s, "k_scale": s, "v_scale": s}
                 for _ in range(layers)]
     return [{"k": s, "v": s} for _ in range(layers)]

@@ -249,6 +249,41 @@ def kv_quant_block(*, kv_dtype: str = "fp32", matched_tokens: int = 0,
     }
 
 
+#: canonical host-tier keys — THE shape of the ``tier`` block every
+#: consumer sees (engine results, bench --mode serving JSON).  Tiering
+#: (--serve-kv-tier host) demotes cold prefix-cache blocks to host RAM
+#: on eviction and promotes them back on a later trie match;
+#: prefill_tokens_saved_tier = promotions * block_size is the prefill
+#: work those re-admissions avoided re-paying.
+TIER_KEYS = ("enabled", "mode", "demotions", "promotions",
+             "host_blocks", "host_blocks_peak",
+             "promote_latency_ms_total", "promote_latency_ms_mean",
+             "prefill_tokens_saved_tier")
+
+
+def tier_block(*, enabled: bool = False, mode: str = "off",
+               demotions: int = 0, promotions: int = 0,
+               host_blocks: int = 0, host_blocks_peak: int = 0,
+               promote_ms_total: float = 0.0,
+               block_size: int = 0) -> dict:
+    """Normalize host-tier counters into the canonical serving ``tier``
+    block — same discipline as the blocks above: every TIER_KEYS key
+    present, plain types, derived rates computed (zero-safely) here."""
+    return {
+        "enabled": bool(enabled),
+        "mode": mode,
+        "demotions": int(demotions),
+        "promotions": int(promotions),
+        "host_blocks": int(host_blocks),
+        "host_blocks_peak": int(host_blocks_peak),
+        "promote_latency_ms_total": round(float(promote_ms_total), 3),
+        "promote_latency_ms_mean": (
+            round(float(promote_ms_total) / promotions, 3)
+            if promotions else 0.0),
+        "prefill_tokens_saved_tier": int(promotions * block_size),
+    }
+
+
 #: canonical goodput-under-SLO keys — THE shape of the ``goodput``
 #: block every consumer sees (bench.py --mode serving JSON, the metric
 #: line's goodput_tokens_per_sec / slo_attainment fields).  Goodput =

@@ -52,10 +52,12 @@
 #            scripts/t1_guard.sh    # workload/goodput layer (loadgen is
 #                                   # host-only: seconds, no jax dispatch)
 #        T1_FILES="tests/test_paged_kernel.py tests/test_kv_quant.py" \
-#            scripts/t1_guard.sh    # int8 KV-quantization layer: parity
-#                                   # + error bounds (test_paged_kernel)
-#                                   # and the prefix/eviction/rollback/
-#                                   # replay composition pins
+#            scripts/t1_guard.sh    # KV quantization + capacity-ladder
+#                                   # layer: int8/int4 parity, error
+#                                   # bounds, residual-lane + packing
+#                                   # pins (test_paged_kernel) and the
+#                                   # prefix/eviction/rollback/replay/
+#                                   # host-tiering composition pins
 #                                   # (test_kv_quant)
 #        T1_FILES="tests/test_prefix_v2.py tests/test_serving.py" \
 #            scripts/t1_guard.sh    # prefix sharing v2 smoke: gen-block

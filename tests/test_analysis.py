@@ -432,6 +432,96 @@ def test_trace_out_post_init_missing_red():
                and "trace_out" in f.message for f in found)
 
 
+def _kv_ladder_tree(*, group_wired=True, tier_validated=True):
+    """The KV capacity-ladder knob pair (--serve-kv-tier/-group) as a
+    minimal bridge fixture: one choices-validated mode knob whose only
+    semantic guard is the coupling check (tiering rides the prefix
+    cache's eviction/match hooks) plus one range-guarded int knob,
+    breakable one layer at a time."""
+    group_wire = ("serve_kv_group=args.serve_kv_group,"
+                  if group_wired else "")
+    tier_post = ('if self.kv_tier == "host" and self.prefix == "off":\n'
+                 '                        raise ValueError("bad")'
+                 if tier_validated else "pass")
+    return {
+        "pkg/cli.py": _src(f"""
+            import argparse
+            from pkg.config import Config
+
+            def build_parser():
+                p = argparse.ArgumentParser()
+                p.add_argument("--serve-kv-tier",
+                               choices=["off", "host"], default="off")
+                p.add_argument("--serve-kv-group",
+                               type=int, default=32)
+                return p
+
+            def config_from_args(args):
+                return Config(
+                    serve_kv_tier=args.serve_kv_tier,
+                    {group_wire})
+
+            def main(argv=None):
+                args = build_parser().parse_args(argv)
+                config = config_from_args(args)
+                if config.serve_kv_tier not in ("off", "host"):
+                    raise SystemExit("bad tier")
+                if config.serve_kv_group < 1:
+                    raise SystemExit("bad group")
+                return config
+            """),
+        "pkg/config.py": _src("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class Config:
+                serve_kv_tier: str = "off"
+                serve_kv_group: int = 32
+            """),
+        "pkg/serve.py": _src(f"""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class ServeConfig:
+                kv_tier: str = "off"
+                kv_group: int = 32
+                prefix: str = "off"
+
+                def __post_init__(self):
+                    {tier_post}
+                    if self.kv_group < 1:
+                        raise ValueError("bad")
+
+                @classmethod
+                def from_config(cls, cfg):
+                    return cls(kv_tier=cfg.serve_kv_tier,
+                               kv_group=cfg.serve_kv_group)
+
+            def use(serve):
+                return (serve.kv_tier, serve.kv_group)
+            """),
+    }
+
+
+def test_kv_ladder_knob_pair_green():
+    tree = _kv_ladder_tree()
+    assert knob_bridge._find_cli(core.parse_sources(tree)) is not None
+    assert knob_bridge.run(tree) == []
+
+
+def test_kv_group_not_wired_red():
+    found = knob_bridge.run(_kv_ladder_tree(group_wired=False))
+    assert any(f.pass_id == "KNOB-FLAG"
+               and "serve-kv-group" in f.message for f in found)
+
+
+def test_kv_tier_post_init_missing_red():
+    found = knob_bridge.run(_kv_ladder_tree(tier_validated=False))
+    assert any(f.pass_id == "KNOB-GUARD"
+               and "__post_init__ never validates" in f.message
+               and "kv_tier" in f.message for f in found)
+
+
 # ---------------------------------------------------------------------
 # recompile-hazard (jit_stability)
 # ---------------------------------------------------------------------

@@ -14,6 +14,12 @@ int8-without-feature): greedy decode over the same quantized pool is
 deterministic, so every composition must be exact.  Int8 vs fp32 is a
 token-match-RATE gate and lives in tests/test_paged_kernel.py and the
 bench --serve-kv-ab arm.
+
+Host-RAM block tiering (--serve-kv-tier host) rides the same
+determinism contract: a demoted block's host bytes equal what a fresh
+prefill of its token path would write, so promotion is byte-exact
+re-admission — pinned below for both quantized rungs, under CoW, and
+through SIGKILL journal replay.
 """
 
 import dataclasses
@@ -283,3 +289,134 @@ class TestInt8JournalReplay:
         _assert_pools_equal(_pool_bytes(straight),
                             _pool_bytes(engines[-1]))
         engines[-1].sched.check_quiescent()
+
+
+# ------------------------------------------------- host-RAM tiering
+
+def _tier_serve(kv_dtype="int8"):
+    """A pool tight enough that three distinct 3-block prefixes cannot
+    all stay device-resident (9 usable blocks, 4 per in-flight request
+    at max_slots=1): the third admission evicts — and with the tier on,
+    demotes — the LRU trie leaf."""
+    return ServeConfig(num_blocks=10, block_size=4, max_slots=1,
+                       max_seq_len=32, prefill_chunk=4,
+                       kv_dtype=kv_dtype, prefix_cache="on",
+                       kv_tier="host")
+
+
+def _tier_prompts(n=3, seed=5, tokens=12):
+    rng = np.random.default_rng(seed)
+    return [list(map(int, rng.integers(0, TINY.vocab_size, tokens)))
+            for _ in range(n)]
+
+
+def _trie_node(cache, key):
+    node = cache._root
+    for chunk in key:
+        node = node.children[chunk]
+    return node
+
+
+class TestHostTiering:
+    def _pressure(self, engine, budget=2):
+        """Run the demotion-forcing phase and return the DEEPEST demoted
+        trie path (its prompt walks surviving device nodes, then
+        promotes the rest of the chain)."""
+        engine.run([Request(i, list(p), budget, arrival=0.0)
+                    for i, p in enumerate(_tier_prompts())])
+        assert engine.tier.demotions >= 1
+        assert len(engine.tier) >= 1
+        return sorted(engine.tier._store, key=len, reverse=True)[0]
+
+    @pytest.mark.parametrize("kv_dtype", ["int8", "int4"])
+    def test_demote_promote_byte_identity(self, model_params, kv_dtype):
+        """THE tiering pin, on both quantized rungs: the promoted
+        device block holds exactly the bytes (codes AND scale siblings)
+        the block carried to host at demotion — round-tripping through
+        np.ndarray storage and the pre-warmed promote dispatch loses
+        nothing."""
+        model, params = model_params
+        engine = PagedDecodeEngine(model, params, _tier_serve(kv_dtype))
+        key = self._pressure(engine)
+        saved = [{name: arr.copy() for name, arr in layer.items()}
+                 for layer in engine.tier._store[key]]
+        prompt = [t for chunk in key for t in chunk]
+        engine.run([Request(99, prompt, 2, arrival=0.0)])
+        assert engine.tier.promotions >= 1
+        assert key not in engine.tier
+        node = _trie_node(engine.prefix_cache, key)
+        for layer, host in zip(engine.pools, saved):
+            assert set(host) == set(layer.keys())
+            for name in host:
+                np.testing.assert_array_equal(
+                    np.asarray(layer[name][node.block]), host[name])
+        engine.sched.check_quiescent()
+
+    def test_promote_under_cow_token_identical(self, model_params):
+        """A re-sent exact-block-multiple prompt promotes its demoted
+        tail block and then recomputes the final prompt position INSIDE
+        it (the len-1 hit cap) — CoW on a freshly promoted shared block.
+        Outputs must equal an untired roomy engine's, and the trie copy
+        must survive the sequence's private write."""
+        model, params = model_params
+        engine = PagedDecodeEngine(model, params, _tier_serve())
+        key = self._pressure(engine)
+        prompt = [t for chunk in key for t in chunk]
+        got = engine.run([Request(99, list(prompt), 4, arrival=0.0)])
+        fresh = PagedDecodeEngine(model, params, SERVE)
+        want = fresh.run([Request(99, list(prompt), 4, arrival=0.0)])
+        assert got["outputs"][99] == want["outputs"][99]
+        assert engine.prefix_cache.stats()["promoted"] >= 1
+        assert got["prefix"]["cow_copies"] >= 1, \
+            "the promoted-final-block recompute was meant to CoW"
+        assert got["tier"]["enabled"] and got["tier"]["promotions"] >= 1
+        assert got["tier"]["prefill_tokens_saved_tier"] > 0
+        engine.sched.check_quiescent()
+
+    def test_sigkill_replay_with_tiering(self, model_params, tmp_path):
+        """Simulated SIGKILL mid-decode with tiering on: the cold
+        restart rebuilds an empty tier (host blocks die with the
+        process, like the device pool) and replays through the journal
+        — merged outputs exactly match an unfaulted tiered run, which
+        itself demotes AND promotes (the scenario bites)."""
+        model, params = model_params
+        serve = _tier_serve()
+        prompts = _tier_prompts()
+
+        def trace():
+            reqs = [Request(i, list(p), 2, arrival=0.0)
+                    for i, p in enumerate(prompts)]
+            reqs.append(Request(3, list(prompts[0]), 2, arrival=0.0))
+            return reqs
+
+        straight = PagedDecodeEngine(model, params, serve)
+        want = straight.run(trace())
+        assert straight.tier.demotions >= 1
+        assert straight.tier.promotions >= 1
+        path = str(tmp_path / "journal.jsonl")
+        state = {"faulted": False}
+
+        def make_engine():
+            engine = PagedDecodeEngine(model, params, serve)
+            if not state["faulted"]:
+                state["faulted"] = True
+                orig, calls = engine._decode_fn, {"n": 0}
+
+                def flaky(*a, **k):
+                    calls["n"] += 1
+                    # budget-2 requests take ~one decode dispatch each
+                    # (the first token rides the prefill argmax): call 3
+                    # lands mid-trace, after the demotions started
+                    if calls["n"] == 3:
+                        raise RuntimeError(
+                            "UNAVAILABLE: simulated device loss")
+                    return orig(*a, **k)
+
+                engine._decode_fn = flaky
+            return engine
+
+        with pytest.raises(RuntimeError):
+            make_engine().run(trace(), journal=ReplayJournal(path))
+        res = run_with_replay(make_engine, trace(), journal_path=path)
+        assert res["outputs"] == want["outputs"]
+        assert all(s == "ok" for s in res["statuses"].values())

@@ -140,6 +140,27 @@ class TestMetricsWriter:
         assert z["capacity_multiplier"] == 0.0
         assert z["effective_capacity_blocks"] == 0
 
+    def test_tier_block_normalizes_counters(self):
+        """The canonical host-tiering block: lifecycle counters, the
+        derived prefill-tokens-saved line (promotions * block_size),
+        and the zero-safe mean promote latency — the one shape the
+        engine result and bench JSON carry under ``tier``."""
+        block = metrics_writer.tier_block(
+            enabled=True, mode="host", demotions=5, promotions=3,
+            host_blocks=2, host_blocks_peak=4,
+            promote_ms_total=1.2345, block_size=4)
+        assert set(block) == set(metrics_writer.TIER_KEYS)
+        assert block["enabled"] and block["mode"] == "host"
+        assert block["prefill_tokens_saved_tier"] == 12   # 3 * 4
+        assert block["promote_latency_ms_total"] == 1.234
+        assert block["promote_latency_ms_mean"] == 0.411  # 1.2345 / 3
+        # zero-safe: tiering off, no promotions, no division blowups
+        z = metrics_writer.tier_block()
+        assert set(z) == set(metrics_writer.TIER_KEYS)
+        assert not z["enabled"] and z["mode"] == "off"
+        assert z["promote_latency_ms_mean"] == 0.0
+        assert z["prefill_tokens_saved_tier"] == 0
+
     def test_write_faults_streams_one_scalar_per_counter(self, tmp_path):
         d = str(tmp_path / "m")
         with metrics_writer.MetricsWriter(d) as mw:

@@ -559,7 +559,7 @@ class TestEngineInt8:
 
     def test_serve_config_validates_kv_dtype(self):
         with pytest.raises(ValueError, match="kv dtype"):
-            ServeConfig(kv_dtype="int4")
+            ServeConfig(kv_dtype="int2")
 
     def test_serve_kv_dtype_knob_bridges_cli_to_engine(self):
         from mpi_tensorflow_tpu import cli
@@ -571,6 +571,286 @@ class TestEngineInt8:
         # default: fp32 — byte-for-byte the pre-quantization pool
         c0 = cli.config_from_args(cli.build_parser().parse_args([]))
         assert ServeConfig.from_config(c0).kv_dtype == "fp32"
+
+
+def _quantize_pools_int4(kp, vp, group=4):
+    """Quantize whole fp32 pools to int4 (packed codes, group scales)
+    pairs; group=4 over the test D=8 gives two scale groups per row, so
+    the group axis actually exercises multi-group dequantization."""
+    kc, ks = paged_ops.quantize_kv_int4(kp, group)
+    vc, vs = paged_ops.quantize_kv_int4(vp, group)
+    return kc, ks, vc, vs
+
+
+class TestInt4Quantization:
+    """The int4 write-side contract: two codes per byte (split-half
+    packing along D), one fp32 scale per group of ``group`` values, and
+    the same write-granularity independence the int8 pins lean on."""
+
+    def test_pack_unpack_roundtrip_exact(self):
+        """Every representable nibble value (-8..7) survives the
+        split-half pack + sign-extending unpack bit-exactly."""
+        rng = np.random.default_rng(0)
+        codes = jnp.asarray(rng.integers(-8, 8, size=(3, 2, 4, 8)),
+                            jnp.int32)
+        packed = paged_ops.pack_int4(codes)
+        assert np.asarray(packed).dtype == np.uint8
+        assert packed.shape == codes.shape[:-1] + (4,)
+        np.testing.assert_array_equal(
+            np.asarray(paged_ops.unpack_int4(packed)), np.asarray(codes))
+
+    def test_roundtrip_error_within_group_absmax_bound(self):
+        """|dequant(quant(x)) - x| <= group_amax/7 per element — the
+        per-GROUP absmax bound (finer than a whole-row scale when
+        magnitudes vary along D)."""
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(6, 2, 4, 8)).astype(np.float32)
+        x[1] *= 1e-4
+        x[2] *= 1e4
+        x[3, :, :, :4] *= 1e3          # per-group adaptation along D
+        codes, scale = paged_ops.quantize_kv_int4(jnp.asarray(x), 4)
+        assert np.asarray(codes).dtype == np.uint8
+        assert np.asarray(scale).shape == x.shape[:-1] + (2,)
+        deq = np.asarray(paged_ops.dequantize_kv_int4(codes, scale,
+                                                      jnp.float32))
+        amax = np.abs(x.reshape(6, 2, 4, 2, 4)).max(-1)
+        bound = np.repeat(amax / 7, 4, axis=-1) + 1e-12
+        assert np.all(np.abs(deq - x) <= bound)
+
+    def test_zero_rows_quantize_inert(self):
+        z = jnp.zeros((2, 2, 4, 8), jnp.float32)
+        codes, scale = paged_ops.quantize_kv_int4(z, 4)
+        assert np.all(np.asarray(codes) == 0)
+        assert np.all(np.asarray(scale) == 0.0)
+        deq = np.asarray(paged_ops.dequantize_kv_int4(codes, scale,
+                                                      jnp.float32))
+        assert np.all(deq == 0.0) and np.all(np.isfinite(deq))
+
+    def test_write_granularity_independent(self):
+        """One S-token dispatch vs per-token writes land byte-identical
+        packed codes AND group scales — group scales span only the head
+        dim, never token rows, so every write shape quantizes each row
+        independently (the property replay and the prefix trie pin)."""
+        rng = np.random.default_rng(5)
+        H, bs, D, S, G = 2, 4, 8, 4, 2
+        kv = jnp.asarray(rng.normal(size=(1, H, S, D)).astype(np.float32))
+        bt = jnp.asarray([[1, 2]], jnp.int32)
+
+        def fresh():
+            return (jnp.zeros((3, H, bs, D // 2), jnp.uint8),
+                    jnp.zeros((3, H, bs, G), jnp.float32))
+
+        pool_a, scale_a = fresh()
+        pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+        pool_a, scale_a = paged_ops.write_kv_quant_int4(
+            pool_a, scale_a, kv, bt, pos, jnp.ones((1, S), bool))
+        pool_b, scale_b = fresh()
+        for t in range(S):
+            pool_b, scale_b = paged_ops.write_kv_quant_int4(
+                pool_b, scale_b, kv[:, :, t:t + 1], bt,
+                jnp.asarray([[t]], jnp.int32), jnp.ones((1, 1), bool))
+        np.testing.assert_array_equal(np.asarray(pool_a),
+                                      np.asarray(pool_b))
+        np.testing.assert_array_equal(np.asarray(scale_a),
+                                      np.asarray(scale_b))
+
+    def test_attend_rejects_one_sided_residual(self):
+        rng = np.random.default_rng(0)
+        q, kp, vp, bt, lens = _case(rng, 1, 1, 4, S=1)
+        kc, ks, vc, vs = _quantize_pools_int4(kp, vp)
+        with pytest.raises(ValueError, match="k_new and v_new"):
+            paged_ops.attend(q, kc, vc, bt, lens, jnp.float32,
+                             kernel="xla", k_scale=ks, v_scale=vs,
+                             k_new=q)
+
+    def test_attend_rejects_residual_on_row_scales(self):
+        rng = np.random.default_rng(0)
+        q, kp, vp, bt, lens = _case(rng, 1, 1, 4, S=1)
+        kc, ks, vc, vs = _quantize_pools(kp, vp)
+        with pytest.raises(ValueError, match="only apply to int4"):
+            paged_ops.attend(q, kc, vc, bt, lens, jnp.float32,
+                             kernel="xla", k_scale=ks, v_scale=vs,
+                             k_new=q, v_new=q)
+
+
+class TestInt4KernelParity:
+    """Interpret-mode kernel vs the XLA gather path over the SAME int4
+    pools — identical packed codes + group scales in, so in-register
+    nibble unpack vs gathered dequantization must agree to fp32
+    tolerance, with and without the fp-residual self lane."""
+
+    def _assert_parity_int4(self, q, kp, vp, bt, lens, dead_rows=(),
+                            residual=False):
+        kc, ks, vc, vs = _quantize_pools_int4(kp, vp)
+        kn = vn = None
+        if residual:
+            rng = np.random.default_rng(99)
+            kn = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+            vn = jnp.asarray(rng.normal(size=q.shape).astype(np.float32))
+        want = paged_ops.attend(q, kc, vc, bt, lens, jnp.float32,
+                                kernel="xla", k_scale=ks, v_scale=vs,
+                                k_new=kn, v_new=vn)
+        got = pk.paged_attention_kernel(q, kc, vc, bt, lens,
+                                        k_scale=ks, v_scale=vs,
+                                        k_new=kn, v_new=vn,
+                                        interpret=True)
+        w, g = np.array(want), np.array(got)
+        for b in dead_rows:
+            w[b] = g[b] = 0.0
+        np.testing.assert_allclose(g, w, rtol=2e-6, atol=2e-6)
+        return got
+
+    @pytest.mark.parametrize("B,NB,bs", [(1, 1, 4), (2, 2, 4),
+                                         (4, 4, 4), (8, 2, 8)])
+    def test_decode_parity_across_bucket_shapes(self, B, NB, bs):
+        rng = np.random.default_rng(B * 100 + NB * 10 + bs)
+        q, kp, vp, bt, lens = _case(rng, B, NB, bs, S=1)
+        self._assert_parity_int4(q, kp, vp, bt, lens,
+                                 dead_rows=(B - 1,) if B > 2 else ())
+
+    @pytest.mark.parametrize("B,NB,bs", [(2, 2, 4), (4, 4, 4)])
+    def test_decode_parity_with_residual_lane(self, B, NB, bs):
+        """The engine's actual int4 decode dispatch: the in-step
+        token's K/V ride in at full precision and override the self
+        column inside the masked softmax — both lowerings must fold
+        the lane identically."""
+        rng = np.random.default_rng(B * 10 + bs)
+        q, kp, vp, bt, lens = _case(rng, B, NB, bs, S=1)
+        self._assert_parity_int4(q, kp, vp, bt, lens,
+                                 dead_rows=(B - 1,) if B > 2 else (),
+                                 residual=True)
+
+    @pytest.mark.parametrize("S", [2, 4, 8])
+    def test_chunked_prefill_parity(self, S):
+        rng = np.random.default_rng(S)
+        q, kp, vp, bt, lens = _case(rng, 2, 4, 4, S=S)
+        kc, ks, vc, vs = _quantize_pools_int4(kp, vp)
+        want = paged_ops.attend(q, kc, vc, bt, lens, jnp.float32,
+                                kernel="xla", k_scale=ks, v_scale=vs)
+        got = pk.paged_prefill_attention(q, kc, vc, bt, lens,
+                                         k_scale=ks, v_scale=vs,
+                                         interpret=True)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-6, atol=2e-6)
+
+    def test_masked_lanes_cannot_leak(self):
+        """Poisoned null-block / beyond-length lanes quantize to huge
+        nibbles + scales — masking must hide them in BOTH int4
+        lowerings (residual variant: the self-lane override must not
+        resurrect them), and the output stays finite."""
+        rng = np.random.default_rng(42)
+        q, kp, vp, bt, lens = _case(rng, 4, 3, 4, S=1, poison=1e30)
+        got = self._assert_parity_int4(q, kp, vp, bt, lens,
+                                       dead_rows=(3,), residual=True)
+        g = np.asarray(got)
+        live = [b for b in range(4) if b != 3]
+        assert np.all(np.isfinite(g[live]))
+
+    def test_bucket_slack_rows_stay_inert(self):
+        rng = np.random.default_rng(7)
+        q, kp, vp, bt, lens = _case(rng, 4, 4, 4, S=1)
+        assert np.all(np.asarray(bt)[3] == 0)
+        self._assert_parity_int4(q, kp, vp, bt, lens, dead_rows=(3,))
+
+
+class TestEngineInt4:
+    """End-to-end int4 serving pins: deterministic, lowering-identical,
+    tracking fp32 at the token-match-rate gate, zero-recompile, pool
+    geometry guards, and the three-knob bridge."""
+
+    def _run(self, model, params, prompts, budgets, **kw):
+        base = dict(num_blocks=40, block_size=4, max_slots=3,
+                    max_seq_len=24, prefill_chunk=8, kernel="xla",
+                    kv_dtype="int4")
+        base.update(kw)
+        engine = PagedDecodeEngine(model, params, ServeConfig(**base))
+        return engine.run([Request(i, p, n) for i, (p, n)
+                           in enumerate(zip(prompts, budgets))])
+
+    def test_int4_deterministic_and_tracks_fp32(self):
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(1))
+        rng = np.random.default_rng(2)
+        prompts = [list(map(int, rng.integers(0, TINY.vocab_size, int(s))))
+                   for s in rng.integers(3, 14, 4)]
+        budgets = [int(n) for n in rng.integers(4, 8, len(prompts))]
+        a = self._run(model, params, prompts, budgets)
+        b = self._run(model, params, prompts, budgets)
+        assert a["outputs"] == b["outputs"], "int4 run nondeterministic"
+        c = self._run(model, params, prompts, budgets, kernel="pallas")
+        assert c["outputs"] == a["outputs"], \
+            "int4 kernel lowering diverged from the int4 gather path"
+        ref = self._run(model, params, prompts, budgets, kv_dtype="fp32")
+        matched = compared = 0
+        for i in a["outputs"]:
+            compared += max(len(ref["outputs"][i]), len(a["outputs"][i]))
+            matched += sum(x == y for x, y in zip(ref["outputs"][i],
+                                                  a["outputs"][i]))
+        # int4 carries ~16x coarser codes than int8; the group scales
+        # plus the fp-residual self lane keep greedy argmax on track —
+        # a lenient floor here, the 0.99 gate lives on the bench trace
+        assert compared > 0 and matched / compared >= 0.9, \
+            f"int4 token match rate {matched}/{compared} below gate"
+
+    def test_zero_recompiles_after_warmup_int4(self):
+        """Packed codes + group-scale siblings are fixed-shape engine
+        state, so the bucketed jit cache discipline must hold under
+        kv_dtype=int4 exactly as under fp32/int8."""
+        model = gpt.CausalLm(TINY)
+        params = model.init(jax.random.key(0))
+        engine = PagedDecodeEngine(model, params, ServeConfig(
+            num_blocks=40, block_size=4, max_slots=4, max_seq_len=32,
+            prefill_chunk=8, kernel="xla", kv_dtype="int4"))
+        rng = np.random.default_rng(3)
+        lens = rng.integers(3, 16, 5)
+        budgets = [int(n) for n in rng.integers(1, 8, 5)]
+
+        def trace(seed):
+            r = np.random.default_rng(seed)
+            return [Request(i, list(map(int, r.integers(
+                        0, TINY.vocab_size, int(s)))), budgets[i])
+                    for i, s in enumerate(lens)]
+
+        engine.run(trace(0))
+        warm = engine.compile_counts()
+        assert warm["decode"] > 0 and warm["prefill"] > 0
+        engine.reset()
+        engine.run(trace(7))
+        assert engine.compile_counts() == warm, \
+            "int4 pool recompiled in steady state"
+
+    def test_init_pools_rejects_bad_geometry(self):
+        cfg = dataclasses.replace(TINY, hidden=28)   # head_dim 7: odd
+        with pytest.raises(ValueError, match="head_dim"):
+            init_pools(cfg, 8, 4, "int4")
+        with pytest.raises(ValueError, match="group"):
+            init_pools(TINY, 8, 4, "int4", kv_group=3)
+
+    def test_serve_config_validates_kv_group(self):
+        with pytest.raises(ValueError, match="kv.group|kv_group"):
+            ServeConfig(kv_group=0)
+
+    def test_kv_ladder_knobs_bridge_cli_to_engine(self):
+        from mpi_tensorflow_tpu import cli
+
+        args = cli.build_parser().parse_args(
+            ["--serve-kv-dtype", "int4", "--serve-kv-group", "16",
+             "--serve-kv-tier", "host", "--serve-prefix-cache", "on"])
+        c = cli.config_from_args(args)
+        assert (c.serve_kv_dtype, c.serve_kv_group,
+                c.serve_kv_tier) == ("int4", 16, "host")
+        serve = ServeConfig.from_config(c)
+        assert (serve.kv_dtype, serve.kv_group,
+                serve.kv_tier) == ("int4", 16, "host")
+        # defaults: fp32 pools, group 32, tiering off
+        c0 = cli.config_from_args(cli.build_parser().parse_args([]))
+        s0 = ServeConfig.from_config(c0)
+        assert (s0.kv_dtype, s0.kv_group, s0.kv_tier) == ("fp32", 32,
+                                                          "off")
+
+    def test_serve_config_couples_tier_to_prefix_cache(self):
+        with pytest.raises(ValueError, match="prefix"):
+            ServeConfig(kv_tier="host", prefix_cache="off")
 
 
 # ---------------------------------------------------------- TPU tier
